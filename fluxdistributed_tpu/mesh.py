@@ -1,0 +1,119 @@
+"""Device and mesh discovery — the TPU-native device layer.
+
+Replaces the reference's device abstraction (src/utils.jl:1-18: the
+``@device!`` macro that dispatches work to a CUDA device and compiles to a
+no-op on CPU, and the ``CUDA.devices()`` enumeration consumed by
+``prepare_training``, src/ddp_tasks.jl:249-258).
+
+On TPU there is no per-device task dispatch: one jitted SPMD program spans
+a ``jax.sharding.Mesh`` and XLA inserts the collectives.  The device layer
+therefore reduces to
+
+* enumerating devices (``devices``/``device_count``),
+* building meshes with named axes (``data_mesh``/``make_mesh``), and
+* the *fake device* story for CI and GPU-less development: with
+  ``JAX_PLATFORMS=cpu`` and
+  ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` the very same
+  mesh/sharding code runs on N virtual CPU devices — the analog of the
+  reference's integer "fake devices" that work because ``@device!`` is a
+  CPU no-op (test/single_device.jl:121-151).
+
+Axis-name conventions used throughout the framework:
+``data`` (batch/DP), ``model`` (tensor parallel), ``seq`` (sequence/context
+parallel), ``pipe`` (pipeline), ``expert`` (MoE).  The reference only has
+DP; the extra axes exist so the same mesh plumbing scales past it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "SEQ_AXIS",
+    "PIPE_AXIS",
+    "EXPERT_AXIS",
+    "devices",
+    "device_count",
+    "data_mesh",
+    "make_mesh",
+    "force_host_devices",
+]
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+
+
+def devices(platform: str | None = None):
+    """All addressable devices, optionally filtered by platform name."""
+    return jax.devices(platform) if platform else jax.devices()
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def data_mesh(n: int | None = None, devs: Sequence | None = None) -> Mesh:
+    """A 1-D mesh over ``n`` devices with the single axis ``data``.
+
+    This is the reference's world: N replicas, gradients mean-reduced
+    across them (src/ddp_tasks.jl:174-247).  ``n`` defaults to all
+    devices.
+    """
+    devs = list(devs if devs is not None else jax.devices())
+    if n is not None:
+        if n > len(devs):
+            raise ValueError(f"requested {n} devices but only {len(devs)} available")
+        devs = devs[:n]
+    return Mesh(np.array(devs), (DATA_AXIS,))
+
+
+def make_mesh(axes: Mapping[str, int], devs: Sequence | None = None) -> Mesh:
+    """An N-D mesh with named axes, e.g. ``{"data": 4, "model": 2}``.
+
+    Axis order follows the mapping order; sizes must multiply to the
+    number of devices used.  Uses ``mesh_utils.create_device_mesh`` so the
+    physical layout rides ICI links where possible.
+    """
+    from jax.experimental import mesh_utils
+
+    names = tuple(axes.keys())
+    shape = tuple(int(v) for v in axes.values())
+    total = int(np.prod(shape))
+    devs = list(devs if devs is not None else jax.devices())
+    if total > len(devs):
+        raise ValueError(f"mesh {dict(axes)} needs {total} devices, have {len(devs)}")
+    devs = devs[:total]
+    if len(devs) == jax.device_count() and devs == list(jax.devices()):
+        arr = mesh_utils.create_device_mesh(shape)
+    else:
+        arr = np.array(devs).reshape(shape)
+    return Mesh(arr, names)
+
+
+def force_host_devices(n: int = 8) -> None:
+    """Configure the process for ``n`` virtual CPU devices.
+
+    Must run before JAX initializes its backends (XLA_FLAGS is read at
+    backend init; the platform override goes through ``jax.config`` so it
+    also wins over an environment-pinned platform).  This is the
+    fake-device test harness: the same SPMD programs that target a TPU
+    slice run on N host devices (the analog of the reference's CPU
+    fake-device mode, src/utils.jl:1-18 + test/single_device.jl:144-150).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")

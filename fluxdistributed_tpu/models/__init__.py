@@ -1,0 +1,13 @@
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
+from .simple import SimpleCNN, MLP
+
+__all__ = [
+    "ResNet",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "resnet101",
+    "resnet152",
+    "SimpleCNN",
+    "MLP",
+]

@@ -1,0 +1,154 @@
+"""ResNet family — TPU-native replacement for the reference's Metalhead
+models (``ResNet(depth)`` used at README.md:27, src/sync.jl:215,
+test/single_device.jl:59).
+
+Layout is NHWC (TPU-preferred; XLA tiles NHWC convs onto the MXU without
+transposes), compute dtype is configurable (bfloat16 by default for the
+MXU, parameters kept float32).
+
+BatchNorm semantics — the reference's unsolved problem: its tests must
+run ``Flux.testmode!`` because per-replica running stats break replica
+equivalence (test/single_device.jl:51-58).  Here there are two modes:
+
+* under plain ``jit`` with the batch sharded on the ``data`` axis, batch
+  statistics are computed over the *global* batch (XLA inserts the
+  cross-replica reductions automatically) — i.e. sync-BN by default, and
+  running stats are identical on every replica by construction;
+* under ``shard_map`` (explicit SPMD), pass ``bn_cross_replica_axis`` to
+  get the same via an explicit ``pmean`` inside BatchNorm
+  (flax's ``axis_name``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152"]
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    """3x3 + 3x3 residual block (ResNet-18/34)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), (self.strides, self.strides), name="downsample_conv"
+            )(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 → 3x3 → 1x1 bottleneck block (ResNet-50/101/152)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), (self.strides, self.strides), name="downsample_conv"
+            )(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """Configurable ResNet, NHWC, bf16 compute / f32 params by default."""
+
+    stage_sizes: Sequence[int]
+    block: type
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    bn_momentum: float = 0.9
+    bn_cross_replica_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.he_normal(),
+            padding="SAME",
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=self.bn_momentum,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            axis_name=self.bn_cross_replica_axis,
+        )
+        x = jnp.asarray(x, self.dtype)
+        x = conv(self.width, (7, 7), (2, 2), name="stem_conv")(x)
+        x = norm(name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, nblocks in enumerate(self.stage_sizes):
+            for j in range(nblocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block(
+                    filters=self.width * (2**i),
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(
+            self.num_classes,
+            dtype=jnp.float32,
+            kernel_init=nn.initializers.variance_scaling(1.0, "fan_in", "truncated_normal"),
+        )(x)
+        return x.astype(jnp.float32)
+
+
+def resnet18(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet([2, 2, 2, 2], BasicBlock, num_classes=num_classes, **kw)
+
+
+def resnet34(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet([3, 4, 6, 3], BasicBlock, num_classes=num_classes, **kw)
+
+
+def resnet50(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet([3, 4, 6, 3], BottleneckBlock, num_classes=num_classes, **kw)
+
+
+def resnet101(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet([3, 4, 23, 3], BottleneckBlock, num_classes=num_classes, **kw)
+
+
+def resnet152(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet([3, 8, 36, 3], BottleneckBlock, num_classes=num_classes, **kw)

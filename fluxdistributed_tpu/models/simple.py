@@ -1,0 +1,47 @@
+"""Small models for tests and smoke runs.
+
+The reference's integration tests build a tiny ``Conv → flatten → Dense``
+chain (test/single_device.jl:115-120) rather than a full ResNet; these
+are the analogs, used by the invariant tests and CPU fake-device runs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["SimpleCNN", "MLP"]
+
+
+class SimpleCNN(nn.Module):
+    """Conv(3x3) → relu → Conv(3x3) → relu → global-avg-pool → Dense."""
+
+    num_classes: int = 10
+    features: int = 16
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = jnp.asarray(x, self.dtype)
+        x = nn.Conv(self.features, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.features * 2, (3, 3), (2, 2), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (32, 10)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f)(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x

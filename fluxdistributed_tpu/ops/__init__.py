@@ -1,0 +1,4 @@
+from .losses import logitcrossentropy, crossentropy, mse
+from .metrics import topkaccuracy, onehot
+
+__all__ = ["logitcrossentropy", "crossentropy", "mse", "topkaccuracy", "onehot"]

@@ -1,0 +1,50 @@
+"""Loss functions.
+
+The reference trains exclusively with ``Flux.Losses.logitcrossentropy``
+(README.md:46; the inner loss closure at src/ddp_tasks.jl:28).  Flux's
+convention is class-major (classes x batch); here we use the JAX-native
+batch-major layout (batch x classes) throughout.
+
+All losses reduce with a *mean over the batch dimension* — under a jitted
+program whose batch is sharded over the ``data`` mesh axis, that global
+mean is exactly what makes XLA emit the gradient all-reduce that replaces
+the reference's hub-reduce (src/ddp_tasks.jl:93-109).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["logitcrossentropy", "crossentropy", "mse"]
+
+
+def logitcrossentropy(logits, labels, label_smoothing: float = 0.0):
+    """Cross entropy on unnormalized logits.
+
+    ``labels`` is one-hot (batch x classes) or integer class ids (batch,).
+    Matches ``Flux.logitcrossentropy`` semantics (mean over batch) with an
+    optional label-smoothing extension.
+    """
+    logits = logits.astype(jnp.float32)
+    nclasses = logits.shape[-1]
+    if labels.ndim == logits.ndim - 1:
+        labels = jax.nn.one_hot(labels, nclasses, dtype=jnp.float32)
+    else:
+        labels = labels.astype(jnp.float32)
+    if label_smoothing > 0.0:
+        labels = labels * (1.0 - label_smoothing) + label_smoothing / nclasses
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+
+
+def crossentropy(probs, labels, eps: float = 1e-12):
+    """Cross entropy on probabilities (post-softmax)."""
+    nclasses = probs.shape[-1]
+    if labels.ndim == probs.ndim - 1:
+        labels = jax.nn.one_hot(labels, nclasses, dtype=jnp.float32)
+    return -jnp.mean(jnp.sum(labels * jnp.log(probs + eps), axis=-1))
+
+
+def mse(pred, target):
+    return jnp.mean(jnp.square(pred - target))

@@ -1,0 +1,37 @@
+"""Evaluation metrics.
+
+Replaces the reference's top-k accuracy stack (``topkaccuracy``
+src/utils.jl:39-45 with its ``maxk!`` partial-sort helper :20-37, used for
+k in {1,5,10} at src/ddp_tasks.jl:129).  On TPU the partial sort becomes
+``jax.lax.top_k``, which XLA lowers natively; the function is
+jit-compatible so eval can run compiled on device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["topkaccuracy", "onehot"]
+
+
+def onehot(labels, nclasses: int):
+    """One-hot encode integer labels — ``Flux.onehotbatch`` analog
+    (src/imagenet.jl:47), batch-major."""
+    return jax.nn.one_hot(labels, nclasses, dtype=jnp.float32)
+
+
+def topkaccuracy(scores, labels, k: int = 5):
+    """Fraction of rows whose true class is within the top-k scores.
+
+    ``scores``: (batch, classes) — logits or probabilities (monotone
+    equivalence makes softmax optional, unlike the reference which
+    softmaxes first at src/ddp_tasks.jl:135).
+    ``labels``: one-hot (batch, classes) or integer ids (batch,).
+    """
+    if labels.ndim == scores.ndim:
+        labels = jnp.argmax(labels, axis=-1)
+    k = min(k, scores.shape[-1])
+    _, topk_idx = jax.lax.top_k(scores, k)
+    hits = jnp.any(topk_idx == labels[:, None], axis=-1)
+    return jnp.mean(hits.astype(jnp.float32))

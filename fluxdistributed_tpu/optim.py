@@ -1,0 +1,312 @@
+"""Functional optimizers matching the reference's Optimisers.jl contract.
+
+The reference pins Optimisers.jl to an early revision whose API is
+``st = Optimisers.state(opt, model)`` then ``m, st = opt(m, grads, st)``
+(reference: src/overloads.jl:1-34 implements exactly those two tree walks;
+README.md:37-38 uses ``Momentum(0.01, 0.9)``; src/sync.jl:97 uses
+``ADAM()``).  The contract is *functional*: the optimizer is a pure value,
+state is an explicit tree, and the update returns new params + new state.
+
+That contract is already the idiomatic JAX shape, so here it is directly:
+
+    opt = momentum(0.01, 0.9)
+    state = opt.init(params)
+    params, state = opt.apply(params, grads, state, step)
+
+``apply`` is pure and jit-compatible (``step`` may be a traced scalar so
+learning-rate schedules compile into the training step).  ``None`` leaves
+in the gradient tree (non-differentiable / stateless layers — the
+reference's ``nothing`` leaves) leave the corresponding parameter and
+state untouched.
+
+Implemented rules (hyperparameter semantics follow Flux/Optimisers.jl
+where the reference uses them, standard forms otherwise):
+
+* ``descent(lr)``          — plain SGD
+* ``momentum(lr, rho)``    — Flux ``Momentum``: v = ρv + ηg; x -= v
+* ``nesterov(lr, rho)``    — Flux ``Nesterov``
+* ``adam(lr, b1, b2, eps)``— bias-corrected Adam (``ADAM()`` analog)
+* ``adamw(...)``           — Adam + decoupled weight decay
+* ``lars(...)``            — layerwise-adaptive momentum for large batch
+                             (the ConvNeXt-XL large-batch config in
+                             BASELINE.json)
+
+Schedules (callables ``step -> lr``, usable anywhere ``lr`` is accepted):
+``constant``, ``step_decay``, ``cosine_decay``, ``warmup_cosine``.
+``step_decay(lr0, 0.2, 10)`` reproduces the reference's legacy LR/5 every
+10 cycles (src/test.jl:50).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+Schedule = Callable[[Any], Any]
+LR = Union[float, Schedule]
+
+__all__ = [
+    "Optimizer",
+    "descent",
+    "momentum",
+    "nesterov",
+    "adam",
+    "adamw",
+    "lars",
+    "constant",
+    "step_decay",
+    "cosine_decay",
+    "warmup_cosine",
+]
+
+
+def _is_none(x):
+    return x is None
+
+
+def _lr_at(lr: LR, step):
+    return lr(step) if callable(lr) else lr
+
+
+def _map(f, *trees):
+    """tree.map over grad trees where ``None`` marks a frozen leaf."""
+    return jax.tree.map(f, *trees, is_leaf=_is_none)
+
+
+@dataclasses.dataclass(frozen=True)
+class Optimizer:
+    """A pure optimizer: ``init(params) -> state``;
+    ``apply(params, grads, state, step) -> (params, state)``."""
+
+    init: Callable[[Pytree], Pytree]
+    update: Callable[[Pytree, Pytree, Pytree, Any], tuple[Pytree, Pytree]]
+    name: str = "optimizer"
+
+    def apply(self, params: Pytree, grads: Pytree, state: Pytree, step=0):
+        return self.update(params, grads, state, step)
+
+    # Allow the reference's call syntax: ``m, st = opt(m, grads, st)``
+    # (src/overloads.jl:1-12).
+    def __call__(self, params: Pytree, grads: Pytree, state: Pytree, step=0):
+        return self.update(params, grads, state, step)
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+
+def descent(lr: LR = 0.1) -> Optimizer:
+    """Plain gradient descent: ``x -= η g``."""
+
+    def init(params):
+        return _map(lambda p: None, params)
+
+    def update(params, grads, state, step):
+        eta = _lr_at(lr, step)
+
+        def f(p, g):
+            return p if g is None else p - eta * g
+
+        return _map(f, params, grads), state
+
+    return Optimizer(init, update, "descent")
+
+
+def momentum(lr: LR = 0.01, rho: float = 0.9) -> Optimizer:
+    """Flux ``Momentum(η, ρ)``: ``v = ρ v + η g; x -= v``.
+
+    The reference's demo optimizer (README.md:37-38).
+    """
+
+    def init(params):
+        return _map(lambda p: None if p is None else jnp.zeros_like(p), params)
+
+    def update(params, grads, state, step):
+        eta = _lr_at(lr, step)
+
+        def fv(v, g):
+            return v if g is None else rho * v + eta * g
+
+        def fp(p, v, g):
+            return p if g is None else p - v
+
+        new_v = _map(fv, state, grads)
+        return _map(fp, params, new_v, grads), new_v
+
+    return Optimizer(init, update, "momentum")
+
+
+def nesterov(lr: LR = 0.01, rho: float = 0.9) -> Optimizer:
+    """Flux ``Nesterov(η, ρ)`` lookahead momentum."""
+
+    def init(params):
+        return _map(lambda p: None if p is None else jnp.zeros_like(p), params)
+
+    def update(params, grads, state, step):
+        eta = _lr_at(lr, step)
+
+        def step_leaf(p, v, g):
+            if g is None:
+                return p, v
+            v2 = rho * v - eta * g
+            d = rho * rho * v - (1 + rho) * eta * g
+            return p + d, v2
+
+        flat_p, treedef = jax.tree.flatten(params, is_leaf=_is_none)
+        flat_v = treedef.flatten_up_to(state)
+        flat_g = treedef.flatten_up_to(grads)
+        out = [step_leaf(p, v, g) for p, v, g in zip(flat_p, flat_v, flat_g)]
+        new_p = treedef.unflatten([o[0] for o in out])
+        new_v = treedef.unflatten([o[1] for o in out])
+        return new_p, new_v
+
+    return Optimizer(init, update, "nesterov")
+
+
+def adam(lr: LR = 1e-3, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> Optimizer:
+    """Bias-corrected Adam — the ``ADAM()`` analog (src/sync.jl:97)."""
+
+    def init(params):
+        def f(p):
+            if p is None:
+                return None
+            return (jnp.zeros_like(p), jnp.zeros_like(p))
+
+        return _map(f, params)
+
+    def update(params, grads, state, step):
+        eta = _lr_at(lr, step)
+        t = jnp.asarray(step, jnp.float32) + 1.0
+        c1 = 1.0 - jnp.power(b1, t)
+        c2 = 1.0 - jnp.power(b2, t)
+
+        def step_leaf(p, mv, g):
+            if g is None:
+                return p, mv
+            m, v = mv
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * (g * g)
+            mhat = m / c1
+            vhat = v / c2
+            return p - eta * mhat / (jnp.sqrt(vhat) + eps), (m, v)
+
+        flat_p, treedef = jax.tree.flatten(params, is_leaf=_is_none)
+        flat_s = treedef.flatten_up_to(state)
+        flat_g = treedef.flatten_up_to(grads)
+        out = [step_leaf(p, s, g) for p, s, g in zip(flat_p, flat_s, flat_g)]
+        new_p = treedef.unflatten([o[0] for o in out])
+        new_s = treedef.unflatten([o[1] for o in out])
+        return new_p, new_s
+
+    return Optimizer(init, update, "adam")
+
+
+def adamw(
+    lr: LR = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 1e-2,
+) -> Optimizer:
+    """Adam with decoupled weight decay (for the ViT/ConvNeXt configs)."""
+    base = adam(lr, b1, b2, eps)
+
+    def update(params, grads, state, step):
+        eta = _lr_at(lr, step)
+        new_p, new_s = base.update(params, grads, state, step)
+
+        def decay(np_, p, g):
+            return np_ if g is None else np_ - eta * weight_decay * p
+
+        return _map(decay, new_p, params, grads), new_s
+
+    return Optimizer(base.init, update, "adamw")
+
+
+def lars(
+    lr: LR = 1.0,
+    momentum_coef: float = 0.9,
+    weight_decay: float = 0.0,
+    trust_coefficient: float = 1e-3,
+    eps: float = 1e-9,
+) -> Optimizer:
+    """LARS — layerwise adaptive rate scaling for large-batch training
+    (the ConvNeXt-XL / ImageNet-21k large-batch config, BASELINE.json)."""
+
+    def init(params):
+        return _map(lambda p: None if p is None else jnp.zeros_like(p), params)
+
+    def update(params, grads, state, step):
+        eta = _lr_at(lr, step)
+
+        def step_leaf(p, v, g):
+            if g is None:
+                return p, v
+            g = g + weight_decay * p
+            p_norm = jnp.linalg.norm(p.reshape(-1))
+            g_norm = jnp.linalg.norm(g.reshape(-1))
+            trust = jnp.where(
+                (p_norm > 0) & (g_norm > 0),
+                trust_coefficient * p_norm / (g_norm + eps),
+                1.0,
+            )
+            v2 = momentum_coef * v + eta * trust * g
+            return p - v2, v2
+
+        flat_p, treedef = jax.tree.flatten(params, is_leaf=_is_none)
+        flat_v = treedef.flatten_up_to(state)
+        flat_g = treedef.flatten_up_to(grads)
+        out = [step_leaf(p, v, g) for p, v, g in zip(flat_p, flat_v, flat_g)]
+        new_p = treedef.unflatten([o[0] for o in out])
+        new_v = treedef.unflatten([o[1] for o in out])
+        return new_p, new_v
+
+    return Optimizer(init, update, "lars")
+
+
+# ---------------------------------------------------------------------------
+# Learning-rate schedules
+# ---------------------------------------------------------------------------
+
+
+def constant(lr: float) -> Schedule:
+    return lambda step: lr
+
+
+def step_decay(lr0: float, factor: float = 0.2, every: int = 10) -> Schedule:
+    """Multiply the LR by ``factor`` every ``every`` steps.
+
+    ``step_decay(lr, 0.2, 10)`` is the reference's legacy schedule — LR/5
+    every 10 cycles (src/test.jl:50).
+    """
+
+    def sched(step):
+        k = jnp.floor(jnp.asarray(step, jnp.float32) / every)
+        return lr0 * jnp.power(factor, k)
+
+    return sched
+
+
+def cosine_decay(lr0: float, total_steps: int, final_fraction: float = 0.0) -> Schedule:
+    def sched(step):
+        t = jnp.clip(jnp.asarray(step, jnp.float32) / max(total_steps, 1), 0.0, 1.0)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * t))
+        return lr0 * (final_fraction + (1.0 - final_fraction) * cos)
+
+    return sched
+
+
+def warmup_cosine(lr0: float, warmup_steps: int, total_steps: int) -> Schedule:
+    cos = cosine_decay(lr0, max(total_steps - warmup_steps, 1))
+
+    def sched(step):
+        s = jnp.asarray(step, jnp.float32)
+        warm = lr0 * s / max(warmup_steps, 1)
+        return jnp.where(s < warmup_steps, warm, cos(s - warmup_steps))
+
+    return sched

@@ -1,0 +1,14 @@
+from .collectives import pmean, psum, all_gather, reduce_scatter, ppermute_ring
+from .dp import TrainState, make_train_step, make_eval_step, make_train_step_shardmap
+
+__all__ = [
+    "pmean",
+    "psum",
+    "all_gather",
+    "reduce_scatter",
+    "ppermute_ring",
+    "TrainState",
+    "make_train_step",
+    "make_eval_step",
+    "make_train_step_shardmap",
+]

@@ -1,0 +1,93 @@
+"""Collective communication layer — XLA collectives over ICI/DCN.
+
+This module is the TPU-native replacement for the reference's entire
+communication backend, which consists of (reference, SURVEY §2):
+
+* intra-node: per-device grad buffers all resident on one HOST GPU,
+  filled by ``copyto!`` DtoD pushes (``markbuffer!``/``getbuffer!``/
+  ``_copyto!`` src/ddp_tasks.jl:59-78) and reduced sequentially on the
+  host device (``sync_buffer`` src/ddp_tasks.jl:93-109) — a hub
+  all-reduce; and
+* inter-node: Julia ``Distributed`` serialization over capacity-1
+  ``RemoteChannel``s to a hub process (``syncgrads`` src/sync.jl:36-81).
+
+On TPU both collapse into compiled XLA collectives emitted inside the
+SPMD program: ``psum``/``pmean`` ride the ICI torus within a slice and
+DCN across slices, with no host round-trip and no hub.  These wrappers
+are meaningful *inside* ``shard_map`` (where a mesh axis name is in
+scope); under plain ``jit`` + sharded inputs, XLA inserts the equivalent
+collectives automatically from the sharding annotations.
+
+``None``-leaf tolerance mirrors the reference's handling of ``nothing``
+gradients for stateless layers.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Pytree = Any
+
+__all__ = ["pmean", "psum", "all_gather", "reduce_scatter", "ppermute_ring"]
+
+
+def _is_none(x):
+    return x is None
+
+
+def psum(tree: Pytree, axis_name: str) -> Pytree:
+    """Tree-wise sum across a mesh axis (``None`` leaves pass through)."""
+    return jax.tree.map(
+        lambda x: None if x is None else lax.psum(x, axis_name),
+        tree,
+        is_leaf=_is_none,
+    )
+
+
+def pmean(tree: Pytree, axis_name: str) -> Pytree:
+    """Tree-wise mean across a mesh axis.
+
+    This single compiled collective IS the reference's gradient
+    averaging: ``sync_buffer``'s accumulate-then-divide
+    (src/ddp_tasks.jl:103-106) and ``syncgrads``'s hard-coded ``/4.f0``
+    (src/sync.jl:68) both become ``pmean`` over the ``data`` axis, with
+    the divisor supplied by the mesh instead of hard-coded.
+    """
+    return jax.tree.map(
+        lambda x: None if x is None else lax.pmean(x, axis_name),
+        tree,
+        is_leaf=_is_none,
+    )
+
+
+def all_gather(tree: Pytree, axis_name: str, axis: int = 0, tiled: bool = True) -> Pytree:
+    """Gather shards from every device along ``axis``."""
+    return jax.tree.map(
+        lambda x: None if x is None else lax.all_gather(x, axis_name, axis=axis, tiled=tiled),
+        tree,
+        is_leaf=_is_none,
+    )
+
+
+def reduce_scatter(tree: Pytree, axis_name: str, axis: int = 0) -> Pytree:
+    """Sum-reduce then scatter shards along ``axis`` (ZeRO-style grad sync)."""
+    return jax.tree.map(
+        lambda x: None if x is None else lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True),
+        tree,
+        is_leaf=_is_none,
+    )
+
+
+def ppermute_ring(x, axis_name: str, shift: int = 1):
+    """Rotate shards one hop around the mesh-axis ring.
+
+    Building block for ring attention / ring all-reduce over ICI
+    neighbours.
+    """
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)

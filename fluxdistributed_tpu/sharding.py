@@ -1,0 +1,114 @@
+"""Sharding helpers: replication, batch sharding, partition rules.
+
+TPU-native replacement for the reference's replica/buffer plumbing in
+``prepare_training`` (src/ddp_tasks.jl:249-289): where the reference
+copies the model onto every GPU (``gpu(resnet)`` per device, :275) and
+allocates per-device grad buffers on a HOST GPU (:263-269), here a single
+``NamedSharding`` annotation replicates parameters across the mesh and
+shards batches along the ``data`` axis — XLA manages placement and
+collective insertion.
+
+Also provides regex partition rules for models that shard parameters
+(tensor parallel / FSDP-style axes) — scope beyond the reference, but the
+mesh plumbing is shared.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import mesh as mesh_lib
+
+Pytree = Any
+
+__all__ = [
+    "P",
+    "replicated",
+    "batch_spec",
+    "replicate",
+    "shard_batch",
+    "partition_by_rules",
+    "make_shardings",
+]
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """The sharding that puts a full copy on every device."""
+    return NamedSharding(mesh, P())
+
+
+def batch_spec(axis: str = mesh_lib.DATA_AXIS) -> P:
+    """PartitionSpec sharding the leading (batch) dimension."""
+    return P(axis)
+
+
+def replicate(tree: Pytree, mesh: Mesh) -> Pytree:
+    """Place a full copy of every leaf on every mesh device.
+
+    Analog of the reference's per-device ``gpu(model)`` / ``gpu(st)``
+    replication loop (src/ddp_tasks.jl:273-276) — one annotation instead
+    of N copies.
+    """
+    s = replicated(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, s), tree)
+
+
+def shard_batch(batch: Pytree, mesh: Mesh, axis: str = mesh_lib.DATA_AXIS) -> Pytree:
+    """Shard every array's leading dim across ``axis`` of the mesh.
+
+    Analog of the reference partitioning the sample table into per-device
+    shards (src/ddp_tasks.jl:257-258) + the per-device ``gpu(shard)``
+    copies inside the DataLoader closure (:280-282).
+    """
+    s = NamedSharding(mesh, P(axis))
+
+    def put(x):
+        x = np.asarray(x) if not isinstance(x, jax.Array) else x
+        n = mesh.shape[axis]
+        if x.shape[0] % n != 0:
+            raise ValueError(
+                f"batch dim {x.shape[0]} not divisible by mesh axis '{axis}' size {n}"
+            )
+        return jax.device_put(x, s)
+
+    return jax.tree.map(put, batch)
+
+
+def partition_by_rules(rules: Sequence[tuple[str, P]], params: Pytree) -> Pytree:
+    """Pytree of PartitionSpecs chosen by regex match on the leaf path.
+
+    Scalars and unmatched leaves are replicated (``P()``).  Used for
+    tensor-parallel / FSDP parameter layouts; data-parallel models just
+    use ``replicated``.
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    specs = []
+    for path, leaf in flat:
+        name = "/".join(
+            getattr(k, "key", getattr(k, "name", str(getattr(k, "idx", k))))
+            for k in path
+        )
+        if np.ndim(leaf) == 0 or int(np.prod(np.shape(leaf))) == 1:
+            specs.append(P())
+            continue
+        for pat, spec in rules:
+            if re.search(pat, name):
+                specs.append(spec)
+                break
+        else:
+            specs.append(P())
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def make_shardings(spec_tree: Pytree, mesh: Mesh) -> Pytree:
+    """Convert a tree of PartitionSpecs into NamedShardings on ``mesh``."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )

@@ -1,0 +1,286 @@
+"""Gradient/parameter pytree machinery.
+
+TPU-native replacement for the reference's hand-rolled grad-tree layer
+(reference: src/ddp_tasks.jl:4-26 ``destruct``/``mywalk``/``_zero``;
+src/overloads.jl:43-54 ``_accum``/``_dodiv``; test/runtests.jl:6-41 the
+recursive ``compare`` comparator and ``getfirst``).
+
+In JAX, gradients already come back as pytrees matching the parameter
+structure, so most of the reference machinery collapses into
+``jax.tree_util``.  What remains useful — and what this module provides —
+is:
+
+* zero-like construction (``zeros_like`` — the ``destruct`` analog),
+* ``None``-tolerant accumulation and scalar division (``accum``/``div`` —
+  the ``_accum``/``_dodiv`` analogs; the reference treats stateless layers
+  as ``nothing`` leaves, JAX uses ``None`` in grad trees the same way),
+* a sequential mean over a list of grad trees (``mean`` — the
+  ``sync_buffer`` hub-reduce analog, src/ddp_tasks.jl:93-109 — used for
+  tests and host-side debugging; the production path is a compiled XLA
+  all-reduce, see ``fluxdistributed_tpu.parallel``),
+* a structural numeric comparator with path-aware error messages
+  (``allclose``/``assert_close`` — the test comparator analog), and
+* small conveniences (``getfirst``, ``count_params``, ``nbytes``, casts).
+
+Every function is pure and jit-compatible unless documented otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+__all__ = [
+    "zeros_like",
+    "accum",
+    "div",
+    "scale",
+    "add_scaled",
+    "mean",
+    "allclose",
+    "assert_close",
+    "getfirst",
+    "count_params",
+    "nbytes",
+    "cast",
+    "to_host",
+    "synchronize",
+]
+
+
+def _is_none(x: Any) -> bool:
+    return x is None
+
+
+def zeros_like(tree: Pytree) -> Pytree:
+    """A zeroed gradient tree with the same structure as ``tree``.
+
+    Analog of the reference's ``destruct`` (src/ddp_tasks.jl:22-26), which
+    walks the model with Functors and replaces every array leaf with
+    ``zero(x)`` and every non-differentiable leaf with ``nothing``.  JAX
+    grad trees carry ``None`` for non-differentiable leaves already, so we
+    simply map ``jnp.zeros_like`` over the non-``None`` leaves.
+    """
+    return jax.tree.map(
+        lambda x: None if x is None else jnp.zeros_like(x),
+        tree,
+        is_leaf=_is_none,
+    )
+
+
+def accum(a: Pytree, b: Pytree) -> Pytree:
+    """Leafwise ``a + b`` where ``None`` acts as an additive identity.
+
+    Analog of ``_accum`` (src/overloads.jl:43-46), which forwards to
+    ``Zygote.accum`` so that ``nothing`` gradients (stateless layers such
+    as pooling/activation) absorb into the other side.
+    """
+
+    def f(x, y):
+        if x is None:
+            return y
+        if y is None:
+            return x
+        return x + y
+
+    return jax.tree.map(f, a, b, is_leaf=_is_none)
+
+
+def div(tree: Pytree, denom) -> Pytree:
+    """Leafwise division by a scalar, skipping ``None`` leaves.
+
+    Analog of ``_dodiv`` (src/overloads.jl:48-54) — the "divide by the
+    number of replicas" half of gradient averaging.
+    """
+    return jax.tree.map(
+        lambda x: None if x is None else x / denom, tree, is_leaf=_is_none
+    )
+
+
+def scale(tree: Pytree, s) -> Pytree:
+    """Leafwise multiplication by a scalar, skipping ``None`` leaves."""
+    return jax.tree.map(
+        lambda x: None if x is None else x * s, tree, is_leaf=_is_none
+    )
+
+
+def add_scaled(a: Pytree, b: Pytree, s) -> Pytree:
+    """``a + s * b`` leafwise, ``None``-tolerant.  Used by optimizers."""
+
+    def f(x, y):
+        if y is None:
+            return x
+        if x is None:
+            return y * s
+        return x + y * s
+
+    return jax.tree.map(f, a, b, is_leaf=_is_none)
+
+
+def mean(trees: Sequence[Pytree]) -> Pytree:
+    """Sequential pairwise accumulate + divide over a list of grad trees.
+
+    This is the semantics (not the implementation) of the reference's hub
+    all-reduce: ``sync_buffer`` folds the per-device buffers pairwise with
+    ``_accum`` on the HOST GPU then divides by N (src/ddp_tasks.jl:93-109);
+    the process-DDP hub does the same in ``syncgrads`` (src/sync.jl:58-69).
+    On TPU the production path is a single compiled ``psum``/``pmean``; this
+    host-side fold exists for tests, debugging, and CPU-only use.
+    """
+    trees = list(trees)
+    if not trees:
+        raise ValueError("mean() of an empty list of trees")
+    acc = trees[0]
+    for t in trees[1:]:
+        acc = accum(acc, t)
+    return div(acc, float(len(trees)))
+
+
+# ---------------------------------------------------------------------------
+# Structural comparison (test comparator analog, test/runtests.jl:6-41)
+# ---------------------------------------------------------------------------
+
+
+def _leaf_mismatches(a, b, rtol, atol, path, out):
+    if a is None and b is None:
+        return
+    if a is None or b is None:
+        out.append((path, "one side is None"))
+        return
+    x = np.asarray(a)
+    y = np.asarray(b)
+    if x.shape != y.shape:
+        out.append((path, f"shape {x.shape} vs {y.shape}"))
+        return
+    if not np.allclose(x, y, rtol=rtol, atol=atol):
+        err = float(np.max(np.abs(x - y))) if x.size else 0.0
+        out.append((path, f"max abs err {err:.3e}"))
+
+
+def mismatches(a: Pytree, b: Pytree, rtol: float = 1e-4, atol: float = 1e-4):
+    """List of ``(path, reason)`` for leaves of ``a`` and ``b`` that differ.
+
+    The reference's test comparator ``compare`` recurses over tuples,
+    NamedTuples, arrays (``isapprox`` at rtol=atol=1f-4 — the defaults
+    here), ``RefValue`` and arbitrary structs (test/runtests.jl:6-29).
+    JAX pytrees subsume all of those container cases.
+    """
+    la = jax.tree.leaves_with_path(a, is_leaf=_is_none)
+    lb = jax.tree.leaves_with_path(b, is_leaf=_is_none)
+    out: list[tuple[str, str]] = []
+    if len(la) != len(lb):
+        return [("<tree>", f"leaf count {len(la)} vs {len(lb)}")]
+    for (pa, xa), (pb, xb) in zip(la, lb):
+        if pa != pb:
+            out.append((jax.tree_util.keystr(pa), f"path mismatch vs {jax.tree_util.keystr(pb)}"))
+            continue
+        _leaf_mismatches(xa, xb, rtol, atol, jax.tree_util.keystr(pa), out)
+    return out
+
+
+def allclose(a: Pytree, b: Pytree, rtol: float = 1e-4, atol: float = 1e-4) -> bool:
+    """True iff every leaf of ``a`` matches ``b`` within tolerance."""
+    return not mismatches(a, b, rtol=rtol, atol=atol)
+
+
+def assert_close(a: Pytree, b: Pytree, rtol: float = 1e-4, atol: float = 1e-4, msg: str = ""):
+    """Assert trees match, raising with the offending paths."""
+    bad = mismatches(a, b, rtol=rtol, atol=atol)
+    if bad:
+        lines = "\n".join(f"  {p}: {r}" for p, r in bad[:20])
+        more = "" if len(bad) <= 20 else f"\n  ... and {len(bad) - 20} more"
+        raise AssertionError(f"trees differ{': ' + msg if msg else ''}\n{lines}{more}")
+
+
+def getfirst(tree: Pytree, name: str):
+    """First leaf (or subtree) reached through a key named ``name``.
+
+    Analog of the reference's test helper ``getfirst`` (test/runtests.jl:37-41)
+    which plucks e.g. the first ``:weight`` out of a nested grad tree.
+    Matches dict keys and dataclass/NamedTuple field names.
+    """
+    found: list[Any] = []
+
+    def walk(node):
+        if found:
+            return
+        if isinstance(node, dict):
+            for k, v in node.items():
+                if found:
+                    return
+                if k == name:
+                    found.append(v)
+                    return
+                walk(v)
+        elif isinstance(node, (list, tuple)):
+            for v in node:
+                walk(v)
+        elif hasattr(node, "_fields"):  # NamedTuple
+            for k in node._fields:
+                v = getattr(node, k)
+                if k == name:
+                    found.append(v)
+                    return
+                walk(v)
+
+    walk(tree)
+    return found[0] if found else None
+
+
+# ---------------------------------------------------------------------------
+# Conveniences
+# ---------------------------------------------------------------------------
+
+
+def count_params(tree: Pytree) -> int:
+    """Total number of scalar parameters in the tree."""
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
+
+
+def nbytes(tree: Pytree) -> int:
+    """Total bytes across all array leaves."""
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
+
+
+def cast(tree: Pytree, dtype) -> Pytree:
+    """Cast every floating-point leaf to ``dtype`` (ints/bools untouched)."""
+
+    def f(x):
+        if x is None:
+            return None
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            return jnp.asarray(x, dtype)
+        return x
+
+    return jax.tree.map(f, tree, is_leaf=_is_none)
+
+
+def to_host(tree: Pytree) -> Pytree:
+    """Copy every leaf to host memory as numpy arrays.
+
+    Analog of the reference returning ``cpu(m)`` replicas at the end of
+    ``train`` (src/ddp_tasks.jl:241-246).
+    """
+    return jax.tree.map(
+        lambda x: None if x is None else np.asarray(jax.device_get(x)),
+        tree,
+        is_leaf=_is_none,
+    )
+
+
+def synchronize(tree: Pytree) -> Pytree:
+    """Block until every leaf's computation has completed; returns the tree.
+
+    Analog of the reference's ``synchronize()`` shim (src/utils.jl:1-5) —
+    on TPU the per-device stream sync becomes ``block_until_ready`` on the
+    relevant arrays.  No-op for non-array leaves.
+    """
+    for x in jax.tree.leaves(tree):
+        if hasattr(x, "block_until_ready"):
+            x.block_until_ready()
+    return tree

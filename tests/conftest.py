@@ -1,0 +1,16 @@
+"""Test harness: 8 virtual CPU devices.
+
+The analog of the reference's fake-device story (test/single_device.jl:
+121-151 — integer fake devices that work because ``@device!`` is a no-op
+without CUDA): here the very same SPMD mesh code runs against
+``--xla_force_host_platform_device_count=8`` CPU devices, so every
+sharding/collective path is exercised on CI hardware.
+
+Must run before any test initializes a JAX backend; this image's
+sitecustomize imports jax at interpreter start, so the platform override
+has to go through ``jax.config`` (which ``force_host_devices`` does).
+"""
+
+from fluxdistributed_tpu.mesh import force_host_devices
+
+force_host_devices(8)

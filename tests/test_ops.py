@@ -1,0 +1,47 @@
+"""Loss and metric tests (reference: topkaccuracy src/utils.jl:20-45,
+logitcrossentropy usage src/ddp_tasks.jl:28)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fluxdistributed_tpu.ops import logitcrossentropy, onehot, topkaccuracy
+
+
+def test_logitcrossentropy_matches_optax():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (16, 10))
+    labels = jax.random.randint(jax.random.PRNGKey(1), (16,), 0, 10)
+    ours = logitcrossentropy(logits, labels)
+    ref = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    assert np.isclose(float(ours), float(ref), rtol=1e-6)
+    # one-hot labels give the same result
+    ours_oh = logitcrossentropy(logits, onehot(labels, 10))
+    assert np.isclose(float(ours_oh), float(ref), rtol=1e-6)
+
+
+def test_label_smoothing_increases_loss_on_confident_preds():
+    logits = jnp.eye(10) * 10.0
+    labels = jnp.arange(10)
+    plain = float(logitcrossentropy(logits, labels))
+    smooth = float(logitcrossentropy(logits, labels, label_smoothing=0.1))
+    assert smooth > plain
+
+
+def test_topkaccuracy_known_case():
+    # row 0: true class 0 ranked 1st; row 1: true class 0 ranked 3rd
+    scores = jnp.array(
+        [[5.0, 1.0, 0.0, 0.0], [1.0, 5.0, 2.0, 0.0]]
+    )
+    labels = jnp.array([0, 0])
+    assert float(topkaccuracy(scores, labels, k=1)) == 0.5
+    assert float(topkaccuracy(scores, labels, k=3)) == 1.0
+    # one-hot labels accepted, as the reference passes onehotbatch labels
+    assert float(topkaccuracy(scores, onehot(labels, 4), k=1)) == 0.5
+
+
+def test_topkaccuracy_k_clamped_and_jittable():
+    scores = jax.random.normal(jax.random.PRNGKey(0), (8, 3))
+    labels = jnp.zeros((8,), jnp.int32)
+    assert 0.0 <= float(jax.jit(lambda s, l: topkaccuracy(s, l, k=3))(scores, labels)) <= 1.0
+    assert float(topkaccuracy(scores, labels, k=10)) == 1.0  # k>classes → all hit
