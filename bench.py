@@ -1,0 +1,97 @@
+"""Benchmark harness: ResNet-50/ImageNet train-step throughput.
+
+The reference publishes NO benchmark numbers (SURVEY §6, BASELINE.md) —
+its only timing hook is dead code.  This harness therefore defines the
+baseline: steady-state images/sec/chip for the full compiled DP training
+step (forward + backward + grad all-reduce + optimizer update, bf16
+compute) on synthetic 224x224 data, the reference's headline workload
+(ResNet-50/ImageNet, README.md:27,43).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+``vs_baseline`` compares against BASELINE_IMAGES_PER_SEC_PER_CHIP below —
+the first recorded number for this framework (the reference has none to
+compare against).  Update it when the bench improves materially.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+# First recorded value on the one available chip (TPU v5e, global batch
+# 128, bf16).  None until a real-TPU number is recorded; vs_baseline is
+# 1.0 in that case.
+BASELINE_IMAGES_PER_SEC_PER_CHIP = None
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import fluxdistributed_tpu as fd
+    from fluxdistributed_tpu import optim, sharding
+    from fluxdistributed_tpu.models import resnet50
+    from fluxdistributed_tpu.parallel import TrainState, make_train_step
+    from fluxdistributed_tpu.parallel.dp import flax_loss_fn
+
+    platform = jax.devices()[0].platform
+    nchips = jax.device_count()
+    mesh = fd.data_mesh()
+    per_chip_batch = 64 if platform == "tpu" else 8
+    batch = per_chip_batch * nchips
+
+    model = resnet50(num_classes=1000)
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (batch, 224, 224, 3)).astype(np.float32)
+    y = rng.integers(0, 1000, batch)
+
+    variables = model.init(jax.random.PRNGKey(0), x[:1], train=True)
+    params = variables["params"]
+    mstate = {k: v for k, v in variables.items() if k != "params"}
+
+    loss_fn = flax_loss_fn(model, fd.logitcrossentropy)
+    opt = optim.momentum(0.1, 0.9)
+    step = make_train_step(loss_fn, opt, mesh)
+    state = TrainState.create(
+        sharding.replicate(params, mesh), opt, model_state=sharding.replicate(mstate, mesh)
+    )
+    b = sharding.shard_batch({"image": x, "label": np.asarray(fd.onehot(y, 1000))}, mesh)
+
+    # compile + warmup
+    state, m = step(state, b)
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    state, m = step(state, b)
+    jax.block_until_ready(m["loss"])
+    warm = time.perf_counter() - t0
+
+    iters = max(3, int(2.0 / max(warm, 1e-3)))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, m = step(state, b)
+    jax.block_until_ready(m["loss"])
+    dt = (time.perf_counter() - t0) / iters
+
+    ips_per_chip = batch / dt / nchips
+    vs = (
+        ips_per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP
+        if BASELINE_IMAGES_PER_SEC_PER_CHIP
+        else 1.0
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"ResNet-50 train-step throughput ({platform}, global batch {batch}, bf16)",
+                "value": round(ips_per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

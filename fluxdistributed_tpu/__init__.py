@@ -13,7 +13,16 @@ The package targets full parity with the reference's exported surface
 below are the currently implemented subset.
 """
 
-from . import mesh, optim, sharding, tree
+from . import data, mesh, optim, sharding, tree
+from .data import (
+    labels,
+    load_registry,
+    minibatch,
+    open_dataset,
+    preprocess,
+    register_dataset,
+    train_solutions,
+)
 from .mesh import data_mesh, make_mesh
 from .ops import logitcrossentropy, topkaccuracy, onehot
 from .parallel import (
@@ -29,10 +38,18 @@ from .parallel.dp import flax_loss_fn
 __version__ = "0.1.0"
 
 __all__ = [
+    "data",
     "mesh",
     "optim",
     "sharding",
     "tree",
+    "labels",
+    "load_registry",
+    "minibatch",
+    "open_dataset",
+    "preprocess",
+    "register_dataset",
+    "train_solutions",
     "data_mesh",
     "make_mesh",
     "logitcrossentropy",

@@ -1,0 +1,59 @@
+"""CIFAR-10 loader.
+
+The reference's ``src/cifar.jl`` is dead code — a Metalhead
+``trainimgs(CIFAR10)`` constant plus an ``assemble`` batch-stacker, never
+``include``d (SURVEY §2 #14).  Here it's a live loader for the standard
+CIFAR-10 binary format (``data_batch_*.bin`` / ``test_batch.bin``: 1
+label byte + 3072 CHW pixel bytes per record), implementing the dataset
+protocol so the ResNet-34/CIFAR-10 reference config (BASELINE.json) runs.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["CIFAR10Dataset"]
+
+_RECORD = 3073
+_TRAIN_FILES = [f"data_batch_{i}.bin" for i in range(1, 6)]
+_TEST_FILES = ["test_batch.bin"]
+
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+
+
+class CIFAR10Dataset:
+    """CIFAR-10 from the binary distribution at ``root`` (optionally
+    under a ``cifar-10-batches-bin/`` subdir)."""
+
+    nclasses = 10
+
+    def __init__(self, root: str, split: str = "train", normalize: bool = True):
+        sub = os.path.join(root, "cifar-10-batches-bin")
+        base = sub if os.path.isdir(sub) else root
+        files = _TRAIN_FILES if split == "train" else _TEST_FILES
+        paths = [os.path.join(base, f) for f in files]
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            raise FileNotFoundError(
+                f"CIFAR-10 binaries not found: {missing[0]} (download the "
+                "'binary version' archive and point path= at it)"
+            )
+        raw = np.concatenate([np.fromfile(p, np.uint8).reshape(-1, _RECORD) for p in paths])
+        self.labels_table = raw[:, 0].astype(np.int32)
+        imgs = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)  # CHW→HWC
+        x = imgs.astype(np.float32) / 255.0
+        if normalize:
+            x = (x - CIFAR10_MEAN) / CIFAR10_STD
+        self.images = x
+
+    def __len__(self):
+        return len(self.labels_table)
+
+    def batch(self, rng: np.random.Generator, n: int, indices=None):
+        if indices is None:
+            indices = rng.integers(0, len(self), size=n)
+        indices = np.asarray(indices)
+        return self.images[indices], self.labels_table[indices]

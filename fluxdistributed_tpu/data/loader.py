@@ -1,0 +1,156 @@
+"""Prefetching device-resident data loader.
+
+TPU-native replacement for the reference's forked-Flux ``DataLoader(f,
+src; buffersize=5)`` — a background task that keeps a channel of
+device-resident batches filled ahead of the training loop
+(src/ddp_tasks.jl:277-284; the fork is pinned in the Manifest, see
+SURVEY §1).  Here: a thread pool assembles host batches (sampling +
+one-hot) and ``jax.device_put``s them with the batch sharding so every
+step's input is already laid out across the mesh when the train loop
+asks for it — host→HBM transfer overlaps compute exactly as the
+reference's prefetch loader overlapped H2D copies.
+
+The loader owns the epoch→cycle accounting the reference does in
+``prepare_training`` (``cycles = nrow*epochs ÷ ndev ÷ nsamples``,
+src/ddp_tasks.jl:256).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import mesh as mesh_lib
+from ..ops import onehot
+
+__all__ = ["PrefetchLoader"]
+
+_STOP = object()
+
+
+class PrefetchLoader:
+    """Iterate device-sharded ``{"image", "label"}`` batches with background prefetch.
+
+    Parameters
+    ----------
+    dataset: object with ``nclasses`` and ``batch(rng, n) -> (imgs, labels)``
+    mesh: the device mesh; batches are sharded on ``axis``
+    batch_size: *global* batch size (reference semantics: per-device batch
+        × number of devices; README.md:43's 96/device × N)
+    cycles: number of batches to produce; ``None`` derives it from
+        ``len(dataset) * epochs // batch_size`` (the reference's
+        epoch→cycle conversion, src/ddp_tasks.jl:256)
+    buffersize: prefetch depth (reference default 5, src/ddp_tasks.jl:278)
+    one_hot: emit one-hot labels (the reference's ``onehotbatch``,
+        src/imagenet.jl:47); integer labels otherwise
+    transform: optional host-side ``(imgs, labels) -> (imgs, labels)``
+    """
+
+    def __init__(
+        self,
+        dataset,
+        mesh: Mesh,
+        batch_size: int,
+        cycles: Optional[int] = None,
+        epochs: int = 1,
+        buffersize: int = 5,
+        seed: int = 0,
+        axis: str = mesh_lib.DATA_AXIS,
+        one_hot: bool = True,
+        num_threads: int = 2,
+        transform: Optional[Callable] = None,
+    ):
+        n = mesh.shape[axis]
+        if batch_size % n:
+            raise ValueError(
+                f"global batch {batch_size} not divisible by mesh axis '{axis}' size {n}"
+            )
+        self.dataset = dataset
+        self.mesh = mesh
+        self.batch_size = batch_size
+        self.buffersize = buffersize
+        self.one_hot = one_hot
+        self.transform = transform
+        self.seed = seed
+        self.num_threads = max(1, num_threads)
+        self.sharding = NamedSharding(mesh, P(axis))
+        if cycles is None:
+            cycles = max(1, (len(dataset) * epochs) // batch_size)
+        self.cycles = cycles
+
+    # -- host-side batch assembly ------------------------------------
+    def _make_batch(self, rng: np.random.Generator):
+        imgs, labels = self.dataset.batch(rng, self.batch_size)
+        if self.transform is not None:
+            imgs, labels = self.transform(imgs, labels)
+        return imgs, labels
+
+    def _put(self, imgs, labels):
+        y = np.asarray(labels)
+        batch = {
+            "image": jax.device_put(np.asarray(imgs), self.sharding),
+            "label": jax.device_put(
+                np.asarray(onehot(y, self.dataset.nclasses)) if self.one_hot else y,
+                self.sharding,
+            ),
+        }
+        return batch
+
+    # -- iteration ----------------------------------------------------
+    def __len__(self) -> int:
+        return self.cycles
+
+    def __iter__(self) -> Iterator[dict]:
+        q: queue.Queue = queue.Queue(maxsize=self.buffersize)
+        counter = iter(range(self.cycles))
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def worker(tid: int):
+            rng = np.random.default_rng(self.seed * 1_000_003 + tid)
+            while not stop.is_set():
+                with lock:
+                    i = next(counter, None)
+                if i is None:
+                    break
+                try:
+                    imgs, labels = self._make_batch(rng)
+                    # device_put from a worker thread: transfer overlaps
+                    # the consumer's compute, like the reference's
+                    # prefetch tasks
+                    item = (i, self._put(imgs, labels), None)
+                except Exception as e:  # surface to the consumer, don't die silently
+                    item = (i, None, e)
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.5)
+                        break
+                    except queue.Full:
+                        continue
+                if item[2] is not None:
+                    return
+
+        threads = [
+            threading.Thread(target=worker, args=(t,), daemon=True)
+            for t in range(self.num_threads)
+        ]
+        for t in threads:
+            t.start()
+
+        delivered = 0
+        try:
+            while delivered < self.cycles:
+                _, batch, err = q.get()
+                if err is not None:
+                    raise RuntimeError("prefetch worker failed while assembling a batch") from err
+                delivered += 1
+                yield batch
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)

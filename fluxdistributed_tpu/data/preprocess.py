@@ -1,0 +1,108 @@
+"""Image decode + preprocessing for ImageNet-style training.
+
+Replaces the reference's preprocessing stack (src/preprocess.jl):
+``resize_smallest_dimension`` 256 with a Gaussian lowpass when
+downscaling (:30-42), ``center_crop`` 224 (:45-49), mean/std ImageNet
+normalization and CHW→WHCN permute (:51-67).  Here decode and resize run
+on host CPU via PIL (JPEG decode stays host-side on TPU too — SURVEY §2
+native-dep table), arrays are NHWC float32, and the device copy happens
+in the prefetch loader.
+
+**The double-normalize quirk.**  The reference multiplies the normalized
+image by 255 (src/preprocess.jl:66) and then ``fproc`` re-standardizes
+each image with ``Flux.normalise`` (src/imagenet.jl:34), so the de-facto
+training distribution is per-image zero-mean/unit-var — the ImageNet
+mean/std wash out.  The clean behavior (resize → crop → (x-μ)/σ) is the
+default here; ``compat_double_normalize=True`` reproduces the
+reference's exact pipeline for parity testing.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Sequence
+
+import numpy as np
+
+__all__ = [
+    "IMAGENET_MEAN",
+    "IMAGENET_STD",
+    "decode_image",
+    "resize_smallest_dimension",
+    "center_crop",
+    "preprocess",
+]
+
+# Reference constants, src/preprocess.jl:51-53
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def decode_image(src) -> np.ndarray:
+    """JPEG/PNG bytes, path, or file-like → RGB uint8 HWC array.
+
+    The ``jpeg_decode`` analog (src/imagenet.jl:32, via libjpeg-turbo);
+    PIL uses libjpeg on the host here.
+    """
+    from PIL import Image
+
+    if isinstance(src, (bytes, bytearray)):
+        src = io.BytesIO(src)
+    img = Image.open(src)
+    if img.mode != "RGB":
+        img = img.convert("RGB")  # handles grayscale/CMYK ImageNet files
+    return np.asarray(img, np.uint8)
+
+
+def resize_smallest_dimension(img: np.ndarray, size: int = 256) -> np.ndarray:
+    """Scale so the smallest side equals ``size`` (aspect preserved).
+
+    The reference lowpass-filters with a Gaussian before downscaling
+    (src/preprocess.jl:30-42, ``imfilter`` + ``imresize``); PIL's
+    ``BILINEAR`` with ``reducing_gap`` performs the equivalent
+    antialiased area reduction.
+    """
+    from PIL import Image
+
+    h, w = img.shape[:2]
+    scale = size / min(h, w)
+    nh, nw = max(size, round(h * scale)), max(size, round(w * scale))
+    pil = Image.fromarray(img)
+    pil = pil.resize((nw, nh), Image.BILINEAR, reducing_gap=2.0)
+    return np.asarray(pil, np.uint8)
+
+
+def center_crop(img: np.ndarray, size: int = 224) -> np.ndarray:
+    """Central ``size``×``size`` crop (src/preprocess.jl:45-49)."""
+    h, w = img.shape[:2]
+    top = (h - size) // 2
+    left = (w - size) // 2
+    return img[top : top + size, left : left + size]
+
+
+def preprocess(
+    img,
+    crop: int = 224,
+    resize: int = 256,
+    mean: Sequence[float] = IMAGENET_MEAN,
+    std: Sequence[float] = IMAGENET_STD,
+    compat_double_normalize: bool = False,
+) -> np.ndarray:
+    """Full pipeline: decode (if needed) → resize → crop → normalize.
+
+    Returns HWC float32 (NHWC once batched) — the TPU-native layout; the
+    reference's WHCN permute (src/preprocess.jl:64-65) is a Julia
+    memory-order artifact with no analog here.
+    """
+    if not isinstance(img, np.ndarray):
+        img = decode_image(img)
+    img = resize_smallest_dimension(img, resize)
+    img = center_crop(img, crop)
+    x = img.astype(np.float32) / 255.0
+    x = (x - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
+    if compat_double_normalize:
+        # Reference quirk: .* 255 after normalizing (src/preprocess.jl:66)
+        # then per-image standardization (Flux.normalise, src/imagenet.jl:34).
+        x = x * 255.0
+        x = (x - x.mean()) / (x.std() + 1e-5)
+    return x

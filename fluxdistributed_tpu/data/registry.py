@@ -1,0 +1,105 @@
+"""Named-dataset registry — the ``Data.toml`` analog.
+
+The reference selects datasets by hard-coded name strings
+(``"imagenet_local"`` / ``"imagenet"`` / ``"imagenet_cyclops"``) resolved
+through DataSets.jl against a ``Data.toml`` listing driver + location
+(Data.toml:4-27; call sites src/ddp_tasks.jl:277, src/sync.jl:112).  Its
+README admits the hard-coding should become an API (README.md:11).
+
+Here that API: a TOML file (``datasets.toml``) declaring named datasets,
+
+    [[datasets]]
+    name = "imagenet_local"
+    driver = "imagenet"             # imagenet | cifar10 | synthetic
+    path = "/data/imagenet"         # filesystem root
+    # driver-specific keys: split, classes, crop, ...
+
+plus programmatic registration (``register_dataset``) and
+``open_dataset(name)`` returning a dataset-protocol object.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from typing import Any, Callable, Optional
+
+__all__ = ["register_dataset", "open_dataset", "load_registry", "DRIVERS"]
+
+_REGISTRY: dict[str, dict] = {}
+
+
+def _driver_imagenet(spec: dict):
+    from .imagenet import ImageNetDataset, labels, train_solutions
+
+    root = spec["path"]
+    lt = labels(spec.get("synset_mapping", os.path.join(root, "LOC_synset_mapping.txt")))
+    table = train_solutions(
+        spec.get("train_solution", os.path.join(root, "LOC_train_solution.csv")),
+        lt,
+        classes=spec.get("classes"),
+    )
+    return ImageNetDataset(
+        root,
+        table,
+        nclasses=len(lt),
+        crop=int(spec.get("crop", 224)),
+        resize=int(spec.get("resize", 256)),
+        compat_double_normalize=bool(spec.get("compat_double_normalize", False)),
+    )
+
+
+def _driver_cifar10(spec: dict):
+    from .cifar import CIFAR10Dataset
+
+    return CIFAR10Dataset(spec["path"], split=spec.get("split", "train"))
+
+
+def _driver_synthetic(spec: dict):
+    from .synthetic import SyntheticDataset
+
+    shape = tuple(spec.get("shape", (32, 32, 3)))
+    return SyntheticDataset(
+        nsamples=int(spec.get("nsamples", 1024)),
+        nclasses=int(spec.get("nclasses", 10)),
+        shape=shape,
+        seed=int(spec.get("seed", 0)),
+    )
+
+
+DRIVERS: dict[str, Callable[[dict], Any]] = {
+    "imagenet": _driver_imagenet,
+    "cifar10": _driver_cifar10,
+    "synthetic": _driver_synthetic,
+}
+
+
+def register_dataset(name: str, driver: str, **spec) -> None:
+    """Programmatic analog of a Data.toml entry."""
+    if driver not in DRIVERS:
+        raise ValueError(f"unknown driver {driver!r}; have {sorted(DRIVERS)}")
+    _REGISTRY[name] = {"driver": driver, **spec}
+
+
+def load_registry(toml_path: str) -> None:
+    """Load ``[[datasets]]`` entries from a TOML file into the registry."""
+    with open(toml_path, "rb") as f:
+        doc = tomllib.load(f)
+    for entry in doc.get("datasets", []):
+        entry = dict(entry)
+        name = entry.pop("name")
+        driver = entry.pop("driver")
+        register_dataset(name, driver, **entry)
+
+
+def open_dataset(name: str, **overrides):
+    """Instantiate the named dataset (``open(BlobTree, dataset(name))``
+    analog, src/sync.jl:112)."""
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"dataset {name!r} not registered; known: {sorted(_REGISTRY)} "
+            "(load a datasets.toml with load_registry or call register_dataset)"
+        )
+    spec = {**_REGISTRY[name], **overrides}
+    driver = spec.pop("driver")
+    return DRIVERS[driver](spec)

@@ -77,6 +77,20 @@ def _map(f, *trees):
     return jax.tree.map(f, *trees, is_leaf=_is_none)
 
 
+def _map_with_state(step_leaf, params, state, grads):
+    """Apply ``step_leaf(p, s, g) -> (p', s')`` across the three trees,
+    tolerating ``None`` grad leaves and per-leaf state of any shape
+    (e.g. Adam's ``(m, v)`` pairs, which a naive tree.map would descend
+    into)."""
+    flat_p, treedef = jax.tree.flatten(params, is_leaf=_is_none)
+    flat_s = treedef.flatten_up_to(state)
+    flat_g = treedef.flatten_up_to(grads)
+    out = [step_leaf(p, s, g) for p, s, g in zip(flat_p, flat_s, flat_g)]
+    new_p = treedef.unflatten([o[0] for o in out])
+    new_s = treedef.unflatten([o[1] for o in out])
+    return new_p, new_s
+
+
 @dataclasses.dataclass(frozen=True)
 class Optimizer:
     """A pure optimizer: ``init(params) -> state``;
@@ -157,13 +171,7 @@ def nesterov(lr: LR = 0.01, rho: float = 0.9) -> Optimizer:
             d = rho * rho * v - (1 + rho) * eta * g
             return p + d, v2
 
-        flat_p, treedef = jax.tree.flatten(params, is_leaf=_is_none)
-        flat_v = treedef.flatten_up_to(state)
-        flat_g = treedef.flatten_up_to(grads)
-        out = [step_leaf(p, v, g) for p, v, g in zip(flat_p, flat_v, flat_g)]
-        new_p = treedef.unflatten([o[0] for o in out])
-        new_v = treedef.unflatten([o[1] for o in out])
-        return new_p, new_v
+        return _map_with_state(step_leaf, params, state, grads)
 
     return Optimizer(init, update, "nesterov")
 
@@ -195,13 +203,7 @@ def adam(lr: LR = 1e-3, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -
             vhat = v / c2
             return p - eta * mhat / (jnp.sqrt(vhat) + eps), (m, v)
 
-        flat_p, treedef = jax.tree.flatten(params, is_leaf=_is_none)
-        flat_s = treedef.flatten_up_to(state)
-        flat_g = treedef.flatten_up_to(grads)
-        out = [step_leaf(p, s, g) for p, s, g in zip(flat_p, flat_s, flat_g)]
-        new_p = treedef.unflatten([o[0] for o in out])
-        new_s = treedef.unflatten([o[1] for o in out])
-        return new_p, new_s
+        return _map_with_state(step_leaf, params, state, grads)
 
     return Optimizer(init, update, "adam")
 
@@ -258,13 +260,7 @@ def lars(
             v2 = momentum_coef * v + eta * trust * g
             return p - v2, v2
 
-        flat_p, treedef = jax.tree.flatten(params, is_leaf=_is_none)
-        flat_v = treedef.flatten_up_to(state)
-        flat_g = treedef.flatten_up_to(grads)
-        out = [step_leaf(p, v, g) for p, v, g in zip(flat_p, flat_v, flat_g)]
-        new_p = treedef.unflatten([o[0] for o in out])
-        new_v = treedef.unflatten([o[1] for o in out])
-        return new_p, new_v
+        return _map_with_state(step_leaf, params, state, grads)
 
     return Optimizer(init, update, "lars")
 

@@ -1,0 +1,16 @@
+from .logging import ConsoleLogger, Logger, current_logger, with_logger
+from .trainer import TrainTask, prepare_training, train
+from .checkpoint import latest_step, load_checkpoint, save_checkpoint
+
+__all__ = [
+    "ConsoleLogger",
+    "Logger",
+    "current_logger",
+    "with_logger",
+    "TrainTask",
+    "prepare_training",
+    "train",
+    "save_checkpoint",
+    "load_checkpoint",
+    "latest_step",
+]

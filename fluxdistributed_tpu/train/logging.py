@@ -1,0 +1,94 @@
+"""Pluggable metric logging.
+
+The reference logs through the Julia ``Logging`` stdlib: ``@info`` records
+with key=value pairs for losses/accuracies (src/ddp_tasks.jl:136-139),
+console ``println`` for cycle cadence (:186), and any ``AbstractLogger``
+(e.g. ``WandbLogger``) can be swapped in by wrapping the call in
+``with_logger`` (README.md:72-92; the Wandb glue is ``@require``-gated at
+src/FluxDistributed.jl:22-24).
+
+Here the same shape: a ``Logger`` protocol, a default ``ConsoleLogger``,
+a ``with_logger`` context manager backed by a contextvar, and an optional
+``WandbLogger`` that activates only if the ``wandb`` package is importable
+(the ``@require`` analog).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import sys
+import time
+from typing import Any, Mapping, Protocol
+
+__all__ = ["Logger", "ConsoleLogger", "WandbLogger", "with_logger", "current_logger"]
+
+
+class Logger(Protocol):
+    def log(self, metrics: Mapping[str, Any], step: int) -> None: ...
+
+    def info(self, msg: str) -> None: ...
+
+
+class ConsoleLogger:
+    """``@info``-style key=value console records with wall-clock stamps."""
+
+    def __init__(self, stream=None):
+        self.stream = stream or sys.stdout
+        self._t0 = time.time()
+
+    def log(self, metrics: Mapping[str, Any], step: int) -> None:
+        kv = " ".join(
+            f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in metrics.items()
+        )
+        print(f"[info] t={time.time() - self._t0:8.1f}s step={step} {kv}", file=self.stream)
+
+    def info(self, msg: str) -> None:
+        print(msg, file=self.stream)
+
+
+class NullLogger:
+    def log(self, metrics: Mapping[str, Any], step: int) -> None:
+        pass
+
+    def info(self, msg: str) -> None:
+        pass
+
+
+class WandbLogger:
+    """Weights & Biases sink, import-gated like the reference's Requires
+    hook (src/FluxDistributed.jl:22-24).  Raises ImportError at
+    construction if wandb isn't installed."""
+
+    def __init__(self, **init_kwargs):
+        import wandb  # gated import — absent from this environment is fine
+
+        self._wandb = wandb
+        self.run = wandb.init(**init_kwargs)
+
+    def log(self, metrics: Mapping[str, Any], step: int) -> None:
+        self._wandb.log(dict(metrics), step=step)
+
+    def info(self, msg: str) -> None:
+        print(msg)
+
+
+_current: contextvars.ContextVar[Logger] = contextvars.ContextVar(
+    "fluxdistributed_tpu_logger", default=ConsoleLogger()
+)
+
+
+def current_logger() -> Logger:
+    return _current.get()
+
+
+@contextlib.contextmanager
+def with_logger(logger: Logger):
+    """Route framework logging through ``logger`` for the dynamic extent —
+    the ``Logging.with_logger`` analog (README.md:72-92)."""
+    token = _current.set(logger)
+    try:
+        yield logger
+    finally:
+        _current.reset(token)

@@ -1,0 +1,174 @@
+"""Data-layer tests: ImageNet metadata parsing, preprocessing, the
+dataset registry and the CIFAR-10 binary loader — against generated
+fixtures (the reference stores no data fixtures either, SURVEY §4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from fluxdistributed_tpu.data import (
+    CIFAR10Dataset,
+    ImageNetDataset,
+    SyntheticDataset,
+    labels,
+    makepaths,
+    minibatch,
+    open_dataset,
+    register_dataset,
+    train_solutions,
+)
+from fluxdistributed_tpu.data.preprocess import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    center_crop,
+    decode_image,
+    preprocess,
+    resize_smallest_dimension,
+)
+from fluxdistributed_tpu.data.registry import load_registry
+
+WNIDS = ["n01440764", "n01443537", "n01484850"]
+
+
+@pytest.fixture(scope="module")
+def imagenet_root(tmp_path_factory):
+    """A miniature ILSVRC tree: synset mapping, train solution CSV, and
+    real JPEG files (generated with PIL)."""
+    from PIL import Image
+
+    root = tmp_path_factory.mktemp("imagenet")
+    with open(root / "LOC_synset_mapping.txt", "w") as f:
+        f.write("n01440764 tench, Tinca tinca\n")
+        f.write("n01443537 goldfish, Carassius auratus\n")
+        f.write("n01484850 great white shark, white shark\n")
+    rows = ["ImageId,PredictionString"]
+    rng = np.random.default_rng(0)
+    for wnid in WNIDS:
+        d = root / "ILSVRC" / "Data" / "CLS-LOC" / "train" / wnid
+        d.mkdir(parents=True)
+        for i in range(3):
+            image_id = f"{wnid}_{i}"
+            arr = rng.integers(0, 255, (80, 100, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"{image_id}.JPEG")
+            rows.append(f"{image_id},{wnid} 1 2 3 4 {wnid} 5 6 7 8")
+    with open(root / "LOC_train_solution.csv", "w") as f:
+        f.write("\n".join(rows) + "\n")
+    return str(root)
+
+
+def test_labels_parse(imagenet_root):
+    lt = labels(os.path.join(imagenet_root, "LOC_synset_mapping.txt"))
+    assert len(lt) == 3
+    assert lt.wnids == WNIDS
+    assert lt.names[0].startswith("tench")
+    assert lt.class_idx["n01443537"] == 1
+
+
+def test_train_solutions_parse_and_filter(imagenet_root):
+    lt = labels(os.path.join(imagenet_root, "LOC_synset_mapping.txt"))
+    csv = os.path.join(imagenet_root, "LOC_train_solution.csv")
+    table = train_solutions(csv, lt)
+    assert len(table) == 9
+    # class filter, as the reference filters to requested classes
+    sub = train_solutions(csv, lt, classes=["n01484850"])
+    assert len(sub) == 3
+    assert set(sub.class_idx.tolist()) == {2}
+
+
+def test_sample_table_shard(imagenet_root):
+    lt = labels(os.path.join(imagenet_root, "LOC_synset_mapping.txt"))
+    table = train_solutions(os.path.join(imagenet_root, "LOC_train_solution.csv"), lt)
+    shards = [table.shard(i, 4) for i in range(4)]
+    assert sum(len(s) for s in shards) == len(table)
+
+
+def test_makepaths_layout():
+    p = makepaths("n01440764_42", "/data", "train")
+    assert p == "/data/ILSVRC/Data/CLS-LOC/train/n01440764/n01440764_42.JPEG"
+    v = makepaths("ILSVRC2012_val_00000001", "/data", "val")
+    assert v.endswith("CLS-LOC/val/ILSVRC2012_val_00000001.JPEG")
+
+
+def test_preprocess_pipeline_stats(imagenet_root):
+    path = makepaths(f"{WNIDS[0]}_0", imagenet_root, "train")
+    img = decode_image(path)
+    assert img.dtype == np.uint8 and img.shape == (80, 100, 3)
+    r = resize_smallest_dimension(img, 64)
+    assert min(r.shape[:2]) == 64
+    c = center_crop(r, 48)
+    assert c.shape == (48, 48, 3)
+    x = preprocess(path, crop=64, resize=72)
+    assert x.shape == (64, 64, 3) and x.dtype == np.float32
+    # uniform-random pixels: after (x-mu)/sigma the mean should sit near
+    # (0.5 - mean)/std per channel
+    expect = ((0.5 - IMAGENET_MEAN) / IMAGENET_STD)
+    assert np.allclose(x.mean(axis=(0, 1)), expect, atol=0.3)
+    # compat mode reproduces the reference's per-image standardization
+    q = preprocess(path, crop=64, resize=72, compat_double_normalize=True)
+    assert abs(float(q.mean())) < 1e-3 and abs(float(q.std()) - 1.0) < 1e-2
+
+
+def test_imagenet_dataset_batch(imagenet_root):
+    lt = labels(os.path.join(imagenet_root, "LOC_synset_mapping.txt"))
+    table = train_solutions(os.path.join(imagenet_root, "LOC_train_solution.csv"), lt)
+    ds = ImageNetDataset(imagenet_root, table, nclasses=3, crop=32, resize=40)
+    imgs, y = ds.batch(np.random.default_rng(0), 8)
+    assert imgs.shape == (8, 32, 32, 3) and y.shape == (8,)
+    assert set(y.tolist()) <= {0, 1, 2}
+    # exported minibatch analog gives one-hot labels
+    mi, my = minibatch(ds, 4, np.random.default_rng(1))
+    assert my.shape == (4, 3) and np.allclose(my.sum(axis=1), 1.0)
+
+
+def test_registry_toml_and_overrides(imagenet_root, tmp_path):
+    toml = tmp_path / "datasets.toml"
+    toml.write_text(
+        f"""
+[[datasets]]
+name = "imagenet_local"
+driver = "imagenet"
+path = "{imagenet_root}"
+crop = 32
+resize = 40
+
+[[datasets]]
+name = "fake"
+driver = "synthetic"
+nsamples = 64
+nclasses = 5
+shape = [8, 8, 3]
+"""
+    )
+    load_registry(str(toml))
+    ds = open_dataset("imagenet_local")
+    assert isinstance(ds, ImageNetDataset) and ds.crop == 32
+    fake = open_dataset("fake")
+    assert isinstance(fake, SyntheticDataset) and fake.nclasses == 5
+    with pytest.raises(KeyError, match="not registered"):
+        open_dataset("nope")
+    register_dataset("fake2", "synthetic", nsamples=16)
+    assert len(open_dataset("fake2")) == 16
+    with pytest.raises(ValueError, match="unknown driver"):
+        register_dataset("bad", "imaginary")
+
+
+def test_cifar10_binary_loader(tmp_path):
+    # forge two records of the binary format: 1 label byte + 3072 CHW bytes
+    rng = np.random.default_rng(0)
+    base = tmp_path / "cifar-10-batches-bin"
+    base.mkdir()
+    for fname in [f"data_batch_{i}.bin" for i in range(1, 6)] + ["test_batch.bin"]:
+        recs = []
+        for lbl in (3, 7):
+            recs.append(np.concatenate([[lbl], rng.integers(0, 255, 3072)]).astype(np.uint8))
+        np.stack(recs).tofile(base / fname)
+    ds = CIFAR10Dataset(str(tmp_path))
+    assert len(ds) == 10  # 5 files x 2 records
+    imgs, y = ds.batch(np.random.default_rng(1), 4)
+    assert imgs.shape == (4, 32, 32, 3)
+    assert set(y.tolist()) <= {3, 7}
+    test = CIFAR10Dataset(str(tmp_path), split="test")
+    assert len(test) == 2
+    with pytest.raises(FileNotFoundError, match="binary"):
+        CIFAR10Dataset(str(tmp_path / "missing"))

@@ -1,0 +1,167 @@
+"""End-to-end trainer tests on the 8-device fake mesh — the analog of the
+reference's integration test (test/single_device.jl:115-168) but stronger:
+it asserts the loss actually falls and exercises the full
+prepare_training → train → host-return pipeline including eval cadence,
+checkpointing and the prefetch loader."""
+
+import io
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from fluxdistributed_tpu import optim
+from fluxdistributed_tpu.data import PrefetchLoader, SyntheticDataset
+from fluxdistributed_tpu.models import MLP, SimpleCNN
+from fluxdistributed_tpu.train import (
+    ConsoleLogger,
+    latest_step,
+    load_checkpoint,
+    prepare_training,
+    save_checkpoint,
+    train,
+    with_logger,
+)
+from fluxdistributed_tpu.train.logging import NullLogger
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from fluxdistributed_tpu import mesh as mesh_lib
+
+    return mesh_lib.data_mesh(8)
+
+
+def test_prefetch_loader_shapes_and_count(mesh):
+    ds = SyntheticDataset(nsamples=256, nclasses=10, shape=(8, 8, 3))
+    dl = PrefetchLoader(ds, mesh, batch_size=32, epochs=2, buffersize=3)
+    assert len(dl) == 256 * 2 // 32
+    batches = list(dl)
+    assert len(batches) == len(dl)
+    b = batches[0]
+    assert b["image"].shape == (32, 8, 8, 3)
+    assert b["label"].shape == (32, 10)
+    # sharded across the mesh, one shard per device
+    assert len(b["image"].sharding.device_set) == 8
+
+
+def test_loader_surfaces_worker_errors(mesh):
+    """A failing batch assembly must raise in the consumer, not deadlock
+    the training loop (regression: worker death used to strand q.get())."""
+
+    class ExplodingDataset:
+        nclasses = 10
+
+        def __len__(self):
+            return 64
+
+        def __init__(self):
+            self.calls = 0
+
+        def batch(self, rng, n):
+            self.calls += 1
+            if self.calls >= 2:
+                raise OSError("disk went away")
+            return np.zeros((n, 4, 4, 3), np.float32), np.zeros(n, np.int32)
+
+    dl = PrefetchLoader(ExplodingDataset(), mesh, batch_size=8, cycles=5, num_threads=1)
+    with pytest.raises(RuntimeError, match="prefetch worker failed"):
+        list(dl)
+
+
+def test_loader_rejects_indivisible_batch(mesh):
+    ds = SyntheticDataset(nsamples=64)
+    with pytest.raises(ValueError, match="divisible"):
+        PrefetchLoader(ds, mesh, batch_size=30)
+
+
+def test_end_to_end_training_loss_falls(mesh, tmp_path):
+    ds = SyntheticDataset(nsamples=512, nclasses=10, shape=(8, 8, 3), seed=3)
+    task = prepare_training(
+        SimpleCNN(num_classes=10, features=8),
+        ds,
+        optim.momentum(0.05, 0.9),
+        mesh=mesh,
+        batch_size=64,
+        cycles=60,
+        val_dataset=ds,
+        val_samples=64,
+        seed=1,
+    )
+    out = io.StringIO()
+    logger = ConsoleLogger(stream=out)
+    first = float(task.eval_fn(task.state, task.val_batch)[0])
+    params, mstate, task = train(
+        task,
+        print_every=10,
+        eval_every=20,
+        logger=logger,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_every=25,
+    )
+    last = float(task.eval_fn(task.state, task.val_batch)[0])
+    assert last < first * 0.7, (first, last)
+    assert int(task.state.step) == 60
+    # host return is numpy
+    assert isinstance(next(iter(jax.tree.leaves(params))), np.ndarray)
+    text = out.getvalue()
+    assert "cycle 0" in text and "cycle 10" in text   # print cadence
+    assert "val_loss" in text and "val_top1" in text and "train_top5" in text
+    # checkpoint written and resumable
+    step = latest_step(str(tmp_path / "ckpt"))
+    assert step is not None and step > 0
+    restored = load_checkpoint(str(tmp_path / "ckpt"), task.state, mesh=mesh)
+    assert int(restored.step) == step
+
+
+def test_with_logger_context(mesh):
+    ds = SyntheticDataset(nsamples=64, shape=(4, 4, 3))
+    task = prepare_training(
+        MLP(features=(16, 10)), ds, optim.descent(0.1), mesh=mesh, batch_size=16, cycles=2
+    )
+    with with_logger(NullLogger()):
+        train(task, print_every=0, eval_every=0)
+    assert int(task.state.step) == 2
+
+
+def test_batchnorm_model_trains_and_stats_update(mesh):
+    """The reference could not keep BatchNorm replicas in sync
+    (test/single_device.jl:51-58 wraps everything in testmode!).  Here the
+    sharded global-batch BN must (a) train and (b) keep identical stats on
+    every device."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class BNNet(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = True):
+            x = nn.Conv(8, (3, 3))(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            x = nn.relu(x)
+            x = jnp.mean(x, axis=(1, 2))
+            return nn.Dense(10, dtype=jnp.float32)(x)
+
+    ds = SyntheticDataset(nsamples=128, shape=(8, 8, 3))
+    task = prepare_training(
+        BNNet(), ds, optim.momentum(0.05, 0.9), mesh=mesh, batch_size=32, cycles=5
+    )
+    zero_stats = jax.tree.leaves(jax.device_get(task.state.model_state))[0].copy()
+    train(task, print_every=0, eval_every=0, logger=NullLogger())
+    stats = task.state.model_state["batch_stats"]
+    moved = any(
+        not np.allclose(np.asarray(a), 0.0)
+        for a in jax.tree.leaves(jax.device_get(stats))
+    )
+    assert moved, "running stats never updated"
+    for leaf in jax.tree.leaves(stats):
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        for s in shards[1:]:
+            np.testing.assert_array_equal(shards[0], s)
+
+
+def test_oom_detection_helper():
+    from fluxdistributed_tpu.train.trainer import _is_oom
+
+    assert _is_oom(RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating"))
+    assert not _is_oom(RuntimeError("invalid argument"))
