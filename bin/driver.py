@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Training driver CLI — the entry point for single- and multi-host runs.
+
+TPU-native replacement for the reference's driver pair (bin/driver.jl +
+bin/main.jl): where the reference `addprocs(4)`s worker processes, parses
+the sample table on process 1, hand-builds two sets of capacity-1
+RemoteChannels and calls `FluxDistributed.start` (bin/driver.jl:3-41),
+here ONE command runs on every host of a pod slice (or alone on a dev
+box):
+
+    # single host (all local chips):
+    python bin/driver.py --model resnet50 --dataset synthetic \
+        --batch-size 256 --cycles 100
+
+    # each host of a TPU pod slice (cluster auto-detected):
+    python bin/driver.py --model resnet50 --dataset imagenet ...
+
+    # manual bring-up (e.g. CPU fake cluster):
+    python bin/driver.py --coordinator localhost:9999 \
+        --num-processes 2 --process-id $I --platform cpu --local-devices 4 ...
+
+The compiled SPMD step is identical in every mode — multi-host changes
+only device enumeration, not the program (contrast with the reference's
+two separate code paths, src/ddp_tasks.jl vs src/sync.jl).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--model", default="resnet50",
+                   help="model factory name in fluxdistributed_tpu.models "
+                        "(resnet18/34/50/101/152, ...)")
+    p.add_argument("--num-classes", type=int, default=None,
+                   help="override class count (default: dataset's)")
+    p.add_argument("--dataset", default="synthetic",
+                   help="registered dataset name (Data.toml analog) or 'synthetic'")
+    p.add_argument("--data-toml", default=None,
+                   help="dataset registry TOML to load (Data.toml analog)")
+    p.add_argument("--val-dataset", default=None, help="registered val dataset name")
+    p.add_argument("--image-size", type=int, default=224,
+                   help="synthetic image side (smoke/test runs use small sizes)")
+    p.add_argument("--batch-size", type=int, default=256,
+                   help="GLOBAL batch size (reference: 96/device x N, README.md:43)")
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--cycles", type=int, default=None,
+                   help="explicit cycle count (overrides epochs)")
+    p.add_argument("--opt", default="momentum", choices=["momentum", "nesterov", "adam", "adamw", "descent", "lars"],
+                   help="optimizer (reference: Momentum(0.01,0.9) README.md:37; ADAM src/sync.jl:97)")
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--warmup-steps", type=int, default=0)
+    p.add_argument("--total-steps", type=int, default=None,
+                   help="enable warmup-cosine schedule to this horizon")
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=20,
+                   help="cycles between checkpoints (reference: 20, src/sync.jl:156)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from latest checkpoint in --checkpoint-dir")
+    p.add_argument("--print-every", type=int, default=10)
+    p.add_argument("--eval-every", type=int, default=50)
+    p.add_argument("--spmd", default="jit", choices=["jit", "shard_map"])
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--wandb", action="store_true", help="log to Weights & Biases")
+    # manual cluster bring-up (CPU fake cluster / debugging)
+    p.add_argument("--coordinator", default=None, help="coordinator host:port")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--platform", default=None, help="force platform (e.g. cpu)")
+    p.add_argument("--local-devices", type=int, default=None,
+                   help="virtual CPU devices per process (fake-cluster mode)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    # Distributed init MUST precede any backend use.
+    from fluxdistributed_tpu.parallel import multihost
+
+    multihost.initialize(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+        platform=args.platform,
+        local_devices=args.local_devices,
+    )
+
+    import jax
+
+    import fluxdistributed_tpu as fd
+    from fluxdistributed_tpu import models, optim
+    from fluxdistributed_tpu.data import SyntheticDataset
+    from fluxdistributed_tpu.train import prepare_training, train
+    from fluxdistributed_tpu.train.logging import ConsoleLogger, NullLogger
+
+    if args.data_toml:
+        fd.load_registry(args.data_toml)
+
+    if args.dataset == "synthetic":
+        dataset = SyntheticDataset(nsamples=max(args.batch_size * 8, 1024),
+                                   nclasses=args.num_classes or 1000,
+                                   shape=(args.image_size, args.image_size, 3))
+    else:
+        dataset = fd.open_dataset(args.dataset)
+    val_dataset = fd.open_dataset(args.val_dataset) if args.val_dataset else None
+
+    model_fn = getattr(models, args.model)
+    model = model_fn(num_classes=args.num_classes or dataset.nclasses)
+
+    lr = args.lr
+    if args.total_steps:
+        lr = optim.warmup_cosine(args.lr, args.warmup_steps, args.total_steps)
+    opt_factory = getattr(optim, args.opt)
+    opt = opt_factory(lr)
+
+    mesh = fd.data_mesh()
+    if multihost.is_coordinator():
+        print(
+            f"devices: {jax.device_count()} ({jax.local_device_count()}/host x "
+            f"{jax.process_count()} hosts), platform "
+            f"{jax.devices()[0].platform}, mesh {dict(mesh.shape)}"
+        )
+
+    task = prepare_training(
+        model, dataset, opt,
+        mesh=mesh,
+        batch_size=args.batch_size,
+        epochs=args.epochs,
+        cycles=args.cycles,
+        val_dataset=val_dataset,
+        spmd=args.spmd,
+    )
+
+    if args.resume and args.checkpoint_dir:
+        from fluxdistributed_tpu.train import latest_step, load_checkpoint
+
+        if latest_step(args.checkpoint_dir) is not None:
+            task.state = load_checkpoint(args.checkpoint_dir, task.state, mesh=mesh)
+            if multihost.is_coordinator():
+                print(f"resumed from step {int(task.state.step)}")
+
+    if args.wandb:
+        from fluxdistributed_tpu.train.logging import WandbLogger
+
+        logger = WandbLogger(project="fluxdistributed_tpu")
+    else:
+        # per-host logs like the reference's per-worker @info records;
+        # non-coordinators stay quiet unless --verbose
+        logger = ConsoleLogger() if (multihost.is_coordinator() or args.verbose) else NullLogger()
+
+    train(
+        task,
+        print_every=args.print_every,
+        eval_every=args.eval_every,
+        logger=logger,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        verbose=args.verbose,
+    )
+    multihost.sync_global_devices("train_done")
+    if multihost.is_coordinator():
+        print(f"done: {int(task.state.step)} steps, {task.num_missed} missed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,22 +79,31 @@ class PrefetchLoader:
         self.seed = seed
         self.num_threads = max(1, num_threads)
         self.sharding = NamedSharding(mesh, P(axis))
+        # Multi-host: each process assembles only its rows of the global
+        # batch (the analog of each reference worker sampling its own
+        # minibatch, src/sync.jl:135); jax.make_array_from_process_local_data
+        # stitches them into one globally-sharded array.
+        from ..parallel import multihost
+
+        self._local_batch = multihost.local_batch_size(batch_size)
         if cycles is None:
             cycles = max(1, (len(dataset) * epochs) // batch_size)
         self.cycles = cycles
 
     # -- host-side batch assembly ------------------------------------
     def _make_batch(self, rng: np.random.Generator):
-        imgs, labels = self.dataset.batch(rng, self.batch_size)
+        imgs, labels = self.dataset.batch(rng, self._local_batch)
         if self.transform is not None:
             imgs, labels = self.transform(imgs, labels)
         return imgs, labels
 
     def _put(self, imgs, labels):
+        from ..parallel.multihost import global_batch_put
+
         y = np.asarray(labels)
         batch = {
-            "image": jax.device_put(np.asarray(imgs), self.sharding),
-            "label": jax.device_put(
+            "image": global_batch_put(np.asarray(imgs), self.sharding),
+            "label": global_batch_put(
                 np.asarray(onehot(y, self.dataset.nclasses)) if self.one_hot else y,
                 self.sharding,
             ),
@@ -112,7 +121,11 @@ class PrefetchLoader:
         stop = threading.Event()
 
         def worker(tid: int):
-            rng = np.random.default_rng(self.seed * 1_000_003 + tid)
+            # distinct stream per (process, thread) so hosts sample
+            # different rows, like the reference's per-worker sampling
+            rng = np.random.default_rng(
+                self.seed * 1_000_003 + jax.process_index() * 7919 + tid
+            )
             while not stop.is_set():
                 with lock:
                     i = next(counter, None)

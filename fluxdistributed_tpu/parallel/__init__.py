@@ -1,7 +1,9 @@
+from . import multihost
 from .collectives import pmean, psum, all_gather, reduce_scatter, ppermute_ring
 from .dp import TrainState, make_train_step, make_eval_step, make_train_step_shardmap
 
 __all__ = [
+    "multihost",
     "pmean",
     "psum",
     "all_gather",

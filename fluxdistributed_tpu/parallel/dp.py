@@ -139,18 +139,35 @@ def make_train_step(
     )
 
 
-def make_eval_step(loss_fn: Callable, mesh: Mesh, axis: str = mesh_lib.DATA_AXIS):
-    """Compiled forward pass returning (loss, logits) — the analog of the
-    two forward passes in ``log_loss_and_acc`` (src/ddp_tasks.jl:130-133),
-    fused into one."""
+def make_eval_step(
+    loss_fn: Callable,
+    mesh: Mesh,
+    axis: str = mesh_lib.DATA_AXIS,
+    topk: tuple = (1, 5, 10),
+):
+    """Compiled eval pass returning ``(loss, metrics)``.
+
+    The analog of ``log_loss_and_acc`` (src/ddp_tasks.jl:128-148), but
+    where the reference runs TWO forward passes and pulls the logits to
+    host for a partial-sort top-k (``topkaccuracy`` src/utils.jl:39-45),
+    here one compiled pass computes loss AND top-k accuracies in-graph
+    (``lax.top_k`` on device).  Outputs are replicated scalars, so this
+    works unchanged on a multi-host mesh where per-shard logits are not
+    host-addressable.
+    """
+    from ..ops import topkaccuracy
+
     repl = NamedSharding(mesh, P())
     shard = NamedSharding(mesh, P(axis))
 
     def step(state: TrainState, batch):
         loss, (_, logits) = loss_fn(state.params, state.model_state, batch, False)
-        return loss, logits
+        metrics = {
+            f"top{k}": topkaccuracy(logits, batch["label"], k=k) for k in topk
+        }
+        return loss, metrics
 
-    return jax.jit(step, in_shardings=(repl, shard), out_shardings=(repl, shard))
+    return jax.jit(step, in_shardings=(repl, shard), out_shardings=(repl, repl))
 
 
 def make_train_step_shardmap(

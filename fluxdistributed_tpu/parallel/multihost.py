@@ -1,0 +1,218 @@
+"""Multi-host (multi-process) distributed runtime.
+
+TPU-native replacement for the reference's process-DDP layer
+(src/sync.jl + bin/driver.jl): where the reference spawns one Julia
+worker process per GPU (``addprocs(4)`` bin/driver.jl:3), moves
+gradients worker→hub over capacity-1 ``RemoteChannel``s with CPU
+serialization (``syncgrads`` src/sync.jl:36-81, worker side :145-149),
+and lock-steps every batch on the hub's average, here:
+
+* one OS process per TPU host joins a *global* JAX runtime via
+  ``jax.distributed.initialize`` (PJRT owns the transport);
+* ``jax.devices()`` then enumerates every chip in the pod slice, so the
+  SAME compiled SPMD train step used single-host spans all hosts — the
+  gradient all-reduce rides ICI within a slice and DCN across slices.
+  There is no hub, no serialization, and no second code path: the
+  process-DDP/task-DDP split of the reference collapses into one
+  program;
+* per-host input feeding goes through
+  ``jax.make_array_from_process_local_data`` — each host assembles only
+  its rows of the global batch (the analog of each reference worker
+  sampling its own minibatch, src/sync.jl:135);
+* the reference's cooperative abort — every worker ``put!``s ``nothing``
+  to end ``syncgrads`` (src/sync.jl:49-53) — becomes ``agree_to_stop``,
+  an all-gather of per-process stop flags.
+
+The same module drives the CPU fake-cluster used in tests: N processes
+x M virtual CPU devices with gloo collectives (see
+tests/test_multihost.py), mirroring how the reference tests its
+machinery without GPUs (test/single_device.jl:121-151).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import mesh as mesh_lib
+
+Pytree = Any
+
+__all__ = [
+    "initialize",
+    "is_distributed",
+    "process_index",
+    "process_count",
+    "is_coordinator",
+    "local_batch_size",
+    "global_batch",
+    "global_batch_put",
+    "host_local_values",
+    "broadcast_from_coordinator",
+    "sync_global_devices",
+    "agree_to_stop",
+]
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    *,
+    platform: Optional[str] = None,
+    local_devices: Optional[int] = None,
+) -> None:
+    """Join (or form) the global distributed runtime.
+
+    On a real TPU pod each host calls this with no arguments — JAX
+    auto-detects the cluster from the TPU metadata (the analog of the
+    reference's ``addprocs`` + driver bring-up, bin/driver.jl:3-23,
+    minus the manual channel plumbing).  On CPU (tests, dev boxes) pass
+    the coordinator address/world explicitly and optionally
+    ``platform="cpu"`` + ``local_devices=N`` for an N-virtual-device
+    fake host; CPU cross-process collectives go through gloo.
+
+    Must run before any JAX backend initializes (this image pre-imports
+    jax, so the platform override goes through ``jax.config``).
+    """
+    if platform is not None:
+        jax.config.update("jax_platforms", platform)
+    if local_devices is not None:
+        jax.config.update("jax_num_cpu_devices", int(local_devices))
+    plat = platform or os.environ.get("JAX_PLATFORMS", "")
+    if "cpu" in plat:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    if num_processes is None and coordinator_address is None:
+        # single-process / auto-detected TPU environment
+        try:
+            jax.distributed.initialize()
+        except (ValueError, RuntimeError) as e:
+            # Only a genuinely-absent cluster environment may fall back to
+            # single-process; anything else (coordinator timeout, partial
+            # metadata) must surface — a silent fallback would let one pod
+            # host train a private model while the rest form a smaller
+            # world.
+            msg = str(e).lower()
+            if "coordinator_address" in msg or "cluster" in msg or "environment" in msg:
+                import warnings
+
+                warnings.warn(
+                    f"no distributed cluster detected ({e}); running single-process"
+                )
+            else:
+                raise
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def is_distributed() -> bool:
+    return jax.process_count() > 1
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """Process 0 — the analog of the reference's hub process 1
+    (``syncgrads`` runs there, src/sync.jl:36), except no reduction work
+    happens here: it only owns logging/checkpoint naming."""
+    return jax.process_index() == 0
+
+
+def local_batch_size(global_batch_size: int) -> int:
+    """Rows of the global batch this host must supply."""
+    n = jax.process_count()
+    if global_batch_size % n:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by {n} processes"
+        )
+    return global_batch_size // n
+
+
+def global_batch(
+    local: Pytree,
+    mesh: Mesh,
+    axis: str = mesh_lib.DATA_AXIS,
+) -> Pytree:
+    """Assemble a globally-sharded batch from per-process local rows.
+
+    Each process passes its own ``local`` arrays (leading dim =
+    global/process_count); the result is a pytree of global
+    ``jax.Array``s sharded ``P(axis)`` across the whole mesh.  This is
+    the data-ingest boundary that replaces the reference workers'
+    per-process ``gpu(minibatch(...))`` (src/sync.jl:135-136) — no
+    cross-host copy happens here; every host feeds only its addressable
+    shards.
+    """
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree.map(lambda x: global_batch_put(x, sharding), local)
+
+
+def global_batch_put(x, sharding) -> jax.Array:
+    """Single-leaf version of :func:`global_batch` for callers that already
+    hold a NamedSharding — the one canonical local-rows→global-array
+    boundary (loader and ``shard_batch`` both route through here)."""
+    x = np.asarray(x)
+    nproc = jax.process_count()
+    if nproc == 1:
+        return jax.device_put(x, sharding)
+    global_shape = (x.shape[0] * nproc, *x.shape[1:])
+    return jax.make_array_from_process_local_data(sharding, x, global_shape)
+
+
+def host_local_values(x) -> np.ndarray:
+    """Gather a (possibly sharded) array's global value onto every host —
+    the analog of the reference hub's ``take!``/CPU materialization
+    (src/sync.jl:43-47), used only at eval/log boundaries."""
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() == 1:
+        return np.asarray(jax.device_get(x))
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def broadcast_from_coordinator(tree: Pytree) -> Pytree:
+    """Broadcast host-side values from process 0 to all processes —
+    the analog of the hub's ``put!.(op, f)`` result broadcast
+    (src/sync.jl:73-77)."""
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() == 1:
+        return tree
+    return multihost_utils.broadcast_one_to_all(tree)
+
+
+def sync_global_devices(tag: str = "barrier") -> None:
+    """Cross-process barrier — the compiled-world analog of the
+    reference's busy-poll ``all(isready, ip)`` barrier (src/sync.jl:41)."""
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() > 1:
+        multihost_utils.sync_global_devices(tag)
+
+
+def agree_to_stop(local_stop: bool) -> bool:
+    """Cooperative abort: True iff ANY process wants to stop.
+
+    The reference ends training when every worker ``put!``s ``nothing``
+    into its gradient channel (src/sync.jl:49-53).  Here each process
+    contributes a flag; any True stops everyone at the same step, so no
+    process hangs in a collective the others never enter.
+    """
+    if jax.process_count() == 1:
+        return bool(local_stop)
+    flags = host_local_values(np.asarray([bool(local_stop)]))
+    return bool(np.any(flags))

@@ -64,8 +64,16 @@ def shard_batch(batch: Pytree, mesh: Mesh, axis: str = mesh_lib.DATA_AXIS) -> Py
     Analog of the reference partitioning the sample table into per-device
     shards (src/ddp_tasks.jl:257-258) + the per-device ``gpu(shard)``
     copies inside the DataLoader closure (:280-282).
+
+    ``batch`` holds the FULL global batch (every host passes the same
+    arrays).  Multi-process: each host feeds only its contiguous row
+    slice through ``jax.make_array_from_process_local_data`` — no host
+    ever materializes another host's shards on device.
     """
+    from .parallel.multihost import global_batch_put, local_batch_size
+
     s = NamedSharding(mesh, P(axis))
+    pi = jax.process_index()
 
     def put(x):
         x = np.asarray(x) if not isinstance(x, jax.Array) else x
@@ -74,7 +82,8 @@ def shard_batch(batch: Pytree, mesh: Mesh, axis: str = mesh_lib.DATA_AXIS) -> Py
             raise ValueError(
                 f"batch dim {x.shape[0]} not divisible by mesh axis '{axis}' size {n}"
             )
-        return jax.device_put(x, s)
+        rows = local_batch_size(x.shape[0])
+        return global_batch_put(np.asarray(x[pi * rows : (pi + 1) * rows]), s)
 
     return jax.tree.map(put, batch)
 

@@ -40,13 +40,24 @@ def _step_dir(directory: str, step: int) -> str:
 
 
 def save_checkpoint(state: Pytree, directory: str, step: int, overwrite: bool = True) -> str:
-    """Write ``state`` (any pytree, e.g. ``TrainState``) at ``directory/step_<n>``."""
+    """Write ``state`` (any pytree, e.g. ``TrainState``) at ``directory/step_<n>``.
+
+    Multi-host: the orbax save itself is collective (every host writes its
+    addressable shards), but the pre-delete of an existing step dir runs
+    on the coordinator only, behind a barrier — concurrent ``rmtree`` from
+    N hosts on a shared filesystem would race the save.
+    """
     path = _step_dir(directory, step)
     ckptr = ocp.StandardCheckpointer()
     if overwrite and os.path.exists(path):
-        import shutil
+        if jax.process_index() == 0:
+            import shutil
 
-        shutil.rmtree(path)
+            shutil.rmtree(path, ignore_errors=True)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("ckpt_rmtree")
     ckptr.save(path, state)
     ckptr.wait_until_finished()
     return path

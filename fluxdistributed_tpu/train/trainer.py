@@ -38,7 +38,7 @@ from .. import mesh as mesh_lib
 from .. import sharding as sharding_lib
 from .. import tree as tree_lib
 from ..data.loader import PrefetchLoader
-from ..ops import logitcrossentropy, onehot, topkaccuracy
+from ..ops import logitcrossentropy, onehot
 from ..optim import Optimizer
 from ..parallel.dp import TrainState, flax_loss_fn, make_eval_step, make_train_step
 from .logging import Logger, current_logger
@@ -81,6 +81,7 @@ def prepare_training(
     input_shape: Optional[Sequence[int]] = None,
     spmd: str = "jit",
     donate: bool = False,
+    topk: Sequence[int] = (1, 5, 10),
 ) -> TrainTask:
     """Initialize params, compile the SPMD step, build prefetch loaders.
 
@@ -116,7 +117,7 @@ def prepare_training(
     else:
         maker = make_train_step
     step_fn = maker(loss_fn, optimizer, mesh, donate=donate)
-    eval_fn = make_eval_step(loss_fn, mesh)
+    eval_fn = make_eval_step(loss_fn, mesh, topk=tuple(topk))
 
     state = TrainState.create(
         sharding_lib.replicate(params, mesh),
@@ -162,14 +163,17 @@ def _is_oom(err: Exception) -> bool:
 
 def _eval_and_log(task: TrainTask, batch, name: str, step: int, topk, logger: Logger):
     """Loss + top-k accuracy on one batch — ``log_loss_and_acc``
-    (src/ddp_tasks.jl:128-148) with the two forward passes fused into the
-    compiled eval step."""
-    loss, logits = task.eval_fn(task.state, batch)
-    logits = np.asarray(jax.device_get(logits))
-    labels = np.asarray(jax.device_get(batch["label"]))
+    (src/ddp_tasks.jl:128-148), computed entirely in the compiled eval
+    step (replicated scalar outputs, multi-host safe)."""
+    loss, accs = task.eval_fn(task.state, batch)
     metrics = {f"{name}_loss": float(loss)}
     for k in topk:
-        metrics[f"{name}_top{k}"] = float(topkaccuracy(logits, labels, k=k))
+        if f"top{k}" not in accs:
+            raise KeyError(
+                f"top-{k} accuracy was not compiled into the eval step — pass "
+                f"topk={tuple(topk)} to prepare_training"
+            )
+        metrics[f"{name}_top{k}"] = float(accs[f"top{k}"])
     logger.log(metrics, step)
     return metrics
 
@@ -213,6 +217,17 @@ def train(
             task.state = new_state
         except Exception as e:  # OOM-skip fault tolerance
             if _is_oom(e):
+                if jax.process_count() > 1:
+                    # Single-host-only semantics, like the reference (skip
+                    # exists in task mode src/ddp_tasks.jl:230-238, NOT in
+                    # process mode src/sync.jl): a one-sided skip would
+                    # desynchronize step counts across hosts and strand
+                    # the others in a collective this host never enters.
+                    raise RuntimeError(
+                        "device OOM on a multi-host run: batch skipping "
+                        "cannot be coordinated one-sidedly — reduce the "
+                        "per-host batch size"
+                    ) from e
                 leaves = jax.tree.leaves(task.state.params)
                 if leaves and getattr(leaves[0], "is_deleted", lambda: False)():
                     raise RuntimeError(

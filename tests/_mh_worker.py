@@ -1,0 +1,89 @@
+"""Worker script for the multi-process (fake multi-host) tests.
+
+Launched as ``python tests/_mh_worker.py <process_id> <num_processes> <port>``
+by tests/test_multihost.py.  Each process owns 2 virtual CPU devices; the
+global mesh spans ``2 * num_processes`` devices across processes, with
+gloo collectives standing in for ICI/DCN — the CPU fake-cluster analog of
+the reference testing its sync machinery without GPUs
+(test/single_device.jl:121-151; the reference's process mode itself has
+NO tests, SURVEY §4).
+"""
+
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    from fluxdistributed_tpu.parallel import multihost
+
+    multihost.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nproc,
+        process_id=pid,
+        platform="cpu",
+        local_devices=2,
+    )
+
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.device_count() == 2 * nproc, jax.device_count()
+
+    from fluxdistributed_tpu import data_mesh, optim
+    from fluxdistributed_tpu.data import SyntheticDataset
+    from fluxdistributed_tpu.models import SimpleCNN
+    from fluxdistributed_tpu.train import prepare_training, train
+    from fluxdistributed_tpu.train.logging import NullLogger
+
+    mesh = data_mesh()
+
+    # -- global_batch: per-process local rows -> one global sharded array
+    local = np.arange(4, dtype=np.float32) + 100.0 * pid
+    g = multihost.global_batch(local, mesh)
+    assert g.shape == (4 * nproc,), g.shape
+    total = jax.jit(
+        jnp.sum,
+        out_shardings=jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+    )(g)
+    expect = sum(float(np.sum(np.arange(4) + 100.0 * p)) for p in range(nproc))
+    assert float(total) == expect, (float(total), expect)
+
+    # -- full DP training across processes: one compiled step, grads
+    #    all-reduced over gloo (the RemoteChannel hub's replacement)
+    ds = SyntheticDataset(nsamples=256, nclasses=10, shape=(16, 16, 3))
+    task = prepare_training(
+        SimpleCNN(num_classes=10),
+        ds,
+        optim.momentum(0.05, 0.9),
+        mesh=mesh,
+        batch_size=4 * nproc,
+        cycles=3,
+        val_dataset=ds,
+        val_samples=8 * nproc,
+    )
+    train(task, print_every=0, eval_every=2, logger=NullLogger())
+    assert int(task.state.step) == 3
+
+    # replicated params must be identical across processes: compare a
+    # param fingerprint via host allgather (ensure_synced analog,
+    # src/ddp_tasks.jl:115-126)
+    leaf = jax.tree.leaves(task.state.params)[0]
+    fp = float(jnp.sum(jnp.abs(leaf)))
+    fps = multihost.host_local_values(np.asarray([fp], np.float32))
+    assert np.allclose(fps, fps[0]), fps
+
+    # -- cooperative abort: any process voting stop stops everyone
+    assert multihost.agree_to_stop(pid == 0) is True
+    assert multihost.agree_to_stop(False) is False
+
+    multihost.sync_global_devices("done")
+    print(f"worker {pid}: OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
