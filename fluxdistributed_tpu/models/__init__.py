@@ -1,5 +1,6 @@
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
 from .simple import SimpleCNN, MLP
+from .vit import ViT, vit_tiny, vit_b16, vit_l16, vit_h14
 
 __all__ = [
     "ResNet",
@@ -10,4 +11,9 @@ __all__ = [
     "resnet152",
     "SimpleCNN",
     "MLP",
+    "ViT",
+    "vit_tiny",
+    "vit_b16",
+    "vit_l16",
+    "vit_h14",
 ]

@@ -1,0 +1,153 @@
+"""Vision Transformer family — net-new model scope beyond the reference.
+
+The reference ships CNNs only (Metalhead ResNets, README.md:27); ViT-L/16
+is one of this framework's BASELINE configs (BASELINE.json "configs").
+Built TPU-first:
+
+* NHWC patchify via a strided conv (one MXU-friendly matmul per patch),
+* bf16 compute / f32 params, f32 softmax and layernorm statistics,
+* the attention implementation is *pluggable* (``attn_fn``) so the same
+  module runs single-device XLA attention, the Pallas flash kernel, or
+  ring-attention context parallelism without touching model code,
+* no python control flow on traced values — whole model jit/scan safe.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+
+__all__ = ["ViT", "vit_tiny", "vit_b16", "vit_l16", "vit_h14"]
+
+AttnFn = Callable  # (q, k, v) -> out, all [B, T, H, D]
+
+
+class MlpBlock(nn.Module):
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        d = x.shape[-1]
+        x = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
+        x = nn.gelu(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.Dense(d, dtype=self.dtype)(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return x
+
+
+class MultiHeadAttention(nn.Module):
+    """QKV projection + pluggable core attention + output projection."""
+
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    attn_fn: Optional[AttnFn] = None
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        assert d % self.num_heads == 0, "embed dim must divide num_heads"
+        head_dim = d // self.num_heads
+        qkv = nn.DenseGeneral(
+            (3, self.num_heads, head_dim), axis=-1, dtype=self.dtype, name="qkv"
+        )(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = self.attn_fn if self.attn_fn is not None else dot_product_attention
+        out = attn(q, k, v)  # [B, T, H, Dh]
+        return nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype, name="out")(out)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.0
+    attn_fn: Optional[AttnFn] = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = MultiHeadAttention(self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn)(y)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = MlpBlock(self.mlp_dim, dtype=self.dtype, dropout=self.dropout)(y, train=train)
+        return x + y
+
+
+class ViT(nn.Module):
+    """Vision Transformer (classification head, mean-pool token readout).
+
+    Mean pooling over tokens instead of a class token keeps the sequence
+    dimension uniform — a deliberate choice so the token axis can be
+    sharded (sequence/context parallelism) without special-casing a
+    non-divisible extra token.
+    """
+
+    patch: int = 16
+    depth: int = 12
+    dim: int = 768
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.0
+    attn_fn: Optional[AttnFn] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = jnp.asarray(x, self.dtype)
+        p = self.patch
+        x = nn.Conv(
+            self.dim, (p, p), strides=(p, p), padding="VALID",
+            dtype=self.dtype, name="patch_embed",
+        )(x)
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, h * w, self.dim), jnp.float32
+        )
+        x = x + pos.astype(self.dtype)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        for i in range(self.depth):
+            x = EncoderBlock(
+                self.num_heads, self.mlp_dim, dtype=self.dtype,
+                dropout=self.dropout, attn_fn=self.attn_fn, name=f"block{i}",
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
+        x = x.mean(axis=1)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def vit_tiny(num_classes: int = 10, **kw) -> ViT:
+    """Tiny config for tests/dryruns (not a published variant)."""
+    return _vit(kw, patch=4, depth=2, dim=64, num_heads=4, mlp_dim=128,
+                num_classes=num_classes)
+
+
+def _vit(kw: dict, **defaults) -> ViT:
+    for key, val in defaults.items():
+        kw.setdefault(key, val)
+    return ViT(**kw)
+
+
+def vit_b16(num_classes: int = 1000, **kw) -> ViT:
+    return _vit(kw, patch=16, depth=12, dim=768, num_heads=12, mlp_dim=3072,
+                num_classes=num_classes)
+
+
+def vit_l16(num_classes: int = 1000, **kw) -> ViT:
+    return _vit(kw, patch=16, depth=24, dim=1024, num_heads=16, mlp_dim=4096,
+                num_classes=num_classes)
+
+
+def vit_h14(num_classes: int = 1000, **kw) -> ViT:
+    return _vit(kw, patch=14, depth=32, dim=1280, num_heads=16, mlp_dim=5120,
+                num_classes=num_classes)

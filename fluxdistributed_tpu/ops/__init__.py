@@ -1,4 +1,13 @@
 from .losses import logitcrossentropy, crossentropy, mse
 from .metrics import topkaccuracy, onehot
+from .attention import dot_product_attention, blockwise_attention
 
-__all__ = ["logitcrossentropy", "crossentropy", "mse", "topkaccuracy", "onehot"]
+__all__ = [
+    "logitcrossentropy",
+    "crossentropy",
+    "mse",
+    "topkaccuracy",
+    "onehot",
+    "dot_product_attention",
+    "blockwise_attention",
+]

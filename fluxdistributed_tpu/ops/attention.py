@@ -1,0 +1,174 @@
+"""Attention ops — net-new TPU scope beyond the reference.
+
+The reference is vision-CNN-only (no attention anywhere; SURVEY §5
+"long-context: absent"), but this framework treats long-context as
+first-class: these ops are the single source of attention semantics for
+
+* the ViT model family (``models/vit.py`` — the ViT-L/16 BASELINE config),
+* the Pallas flash-attention TPU kernel and the ring-attention context
+  parallelism layer, both of which reuse the online-softmax block update
+  defined here.
+
+All functions take ``q, k, v`` shaped ``[batch, seq, heads, head_dim]``
+(BTHD — the layout XLA prefers for TPU attention: the matmuls contract
+over head_dim/seq and batch×heads map onto MXU batching).  Softmax
+statistics are always accumulated in float32 regardless of input dtype.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "dot_product_attention",
+    "blockwise_attention",
+    "AttnCarry",
+    "attn_block_update",
+    "attn_finalize",
+]
+
+NEG_INF = -1e30
+
+
+def _scale(q):
+    return q / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32)).astype(q.dtype)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Reference softmax attention, one XLA fusion.
+
+    ``q``: [B, Tq, H, D]; ``k``/``v``: [B, Tk, H, D] → [B, Tq, H, D].
+    ``mask``: optional [B?, H?, Tq, Tk] additive-compatible boolean mask
+    (True = attend).  f32 softmax, output in q.dtype.
+    """
+    q = _scale(q)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        # Align ends: allows Tq != Tk (e.g. decoding with a KV cache).
+        idx_q = jnp.arange(tq)[:, None] + (tk - tq)
+        s = jnp.where(jnp.arange(tk)[None, :] <= idx_q, s, NEG_INF)
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+
+
+class AttnCarry(NamedTuple):
+    """Online-softmax accumulator state for blockwise/ring attention.
+
+    ``o``   [B, Tq, H, D] float32 — un-normalized output accumulator
+    ``m``   [B, H, Tq]    float32 — running row max of scores
+    ``l``   [B, H, Tq]    float32 — running sum of exp(scores - m)
+    """
+
+    o: jax.Array
+    m: jax.Array
+    l: jax.Array
+
+
+def attn_init(q: jax.Array) -> AttnCarry:
+    b, tq, h, d = q.shape
+    return AttnCarry(
+        o=jnp.zeros((b, tq, h, d), jnp.float32),
+        m=jnp.full((b, h, tq), NEG_INF, jnp.float32),
+        l=jnp.zeros((b, h, tq), jnp.float32),
+    )
+
+
+def attn_block_update(
+    carry: AttnCarry,
+    q_scaled: jax.Array,
+    k_blk: jax.Array,
+    v_blk: jax.Array,
+    *,
+    mask: Optional[jax.Array] = None,
+) -> AttnCarry:
+    """Fold one KV block into the online-softmax accumulator.
+
+    The single numerical building block shared by ``blockwise_attention``
+    (local loop over KV blocks) and ring attention (loop over KV blocks
+    arriving over ICI via ``ppermute``).  ``q_scaled`` must already be
+    divided by sqrt(head_dim) — scaling is the caller's job so it happens
+    once, not once per block inside a scan.  ``mask``: [Tq, Tk_blk]
+    boolean, True = attend (causal masking with global positions, and
+    padding introduced by non-divisible block sizes).
+    """
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q_scaled, k_blk, preferred_element_type=jnp.float32
+    )
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_new = jnp.maximum(carry.m, s.max(axis=-1))
+    corr = jnp.exp(carry.m - m_new)
+    p = jnp.exp(s - m_new[..., None])  # [B,H,Tq,Tk]
+    if mask is not None:
+        # For a row masked in EVERY position so far, m_new is still
+        # NEG_INF and exp(s - m_new) = exp(0) = 1 — zero those entries
+        # explicitly so fully-masked rows keep l == 0 and finalize to 0.
+        p = jnp.where(mask[None, None], p, 0.0)
+    l_new = carry.l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+    o_new = carry.o * corr.transpose(0, 2, 1)[..., None] + pv
+    return AttnCarry(o=o_new, m=m_new, l=l_new)
+
+
+def attn_finalize(carry: AttnCarry, dtype) -> jax.Array:
+    """Normalize the accumulator into the final attention output."""
+    l = jnp.maximum(carry.l, 1e-30)  # fully-masked rows → 0 output, not NaN
+    return (carry.o / l.transpose(0, 2, 1)[..., None]).astype(dtype)
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    block_size: int = 512,
+    causal: bool = False,
+) -> jax.Array:
+    """Flash-style attention via ``lax.scan`` over KV blocks.
+
+    Memory-bounded in seq length (never materializes [Tq, Tk] for the
+    full sequence) with identical numerics to ``dot_product_attention``.
+    This is the XLA fallback for the Pallas kernel and the single-device
+    analog of ring attention (one ring hop == one scan iteration).
+    """
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    block_size = min(block_size, tk)
+    pad = -tk % block_size  # pad (masked) rather than fall back to one block
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    nblocks = (tk + pad) // block_size
+    kb = k.reshape(b, nblocks, block_size, h, d).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(b, nblocks, block_size, h, d).transpose(1, 0, 2, 3, 4)
+
+    q_scaled = _scale(q)
+    q_pos = jnp.arange(tq) + (tk - tq)
+
+    def body(carry, xs):
+        blk_idx, k_blk, v_blk = xs
+        k_pos = blk_idx * block_size + jnp.arange(block_size)
+        mask = k_pos[None, :] < tk
+        if causal:
+            mask &= k_pos[None, :] <= q_pos[:, None]
+        elif not pad:
+            mask = None
+        return attn_block_update(carry, q_scaled, k_blk, v_blk, mask=mask), None
+
+    carry, _ = jax.lax.scan(body, attn_init(q), (jnp.arange(nblocks), kb, vb))
+    return attn_finalize(carry, q.dtype)

@@ -1,0 +1,168 @@
+"""Attention ops + ViT tests.
+
+Net-new scope (the reference has no attention; SURVEY §5), so the test
+model here is internal consistency: every attention implementation —
+reference XLA softmax, blockwise/online-softmax, (later) Pallas and ring
+— must agree numerically, mirroring how the reference pins its DP
+machinery to single-batch gradients (test/single_device.jl:42-62).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fluxdistributed_tpu.ops.attention import (
+    blockwise_attention,
+    dot_product_attention,
+)
+
+
+def _qkv(b=2, t=64, h=4, d=16, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (b, t, h, d)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+def test_blockwise_matches_reference():
+    q, k, v = _qkv()
+    ref = dot_product_attention(q, k, v)
+    blk = blockwise_attention(q, k, v, block_size=16)
+    np.testing.assert_allclose(np.asarray(blk), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_blockwise_causal_matches_reference():
+    q, k, v = _qkv()
+    ref = dot_product_attention(q, k, v, causal=True)
+    blk = blockwise_attention(q, k, v, block_size=16, causal=True)
+    np.testing.assert_allclose(np.asarray(blk), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_causal_first_token_ignores_future():
+    q, k, v = _qkv(t=8)
+    out = dot_product_attention(q, k, v, causal=True)
+    # Row 0 may only attend to position 0 → output == v[:, 0].
+    np.testing.assert_allclose(
+        np.asarray(out[:, 0]), np.asarray(v[:, 0]), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_mask_equivalent_to_causal():
+    q, k, v = _qkv(t=16)
+    t = q.shape[1]
+    mask = jnp.tril(jnp.ones((t, t), bool))[None, None]
+    a = dot_product_attention(q, k, v, causal=True)
+    b = dot_product_attention(q, k, v, mask=mask)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6)
+
+
+def test_blockwise_non_divisible_block_size():
+    """Tk not divisible by block_size must pad+mask, not fall back."""
+    q, k, v = _qkv(t=50)
+    ref = dot_product_attention(q, k, v)
+    blk = blockwise_attention(q, k, v, block_size=16)
+    np.testing.assert_allclose(np.asarray(blk), np.asarray(ref), rtol=1e-5, atol=1e-5)
+    refc = dot_product_attention(q, k, v, causal=True)
+    blkc = blockwise_attention(q, k, v, block_size=16, causal=True)
+    np.testing.assert_allclose(np.asarray(blkc), np.asarray(refc), rtol=1e-5, atol=1e-5)
+
+
+def test_fully_masked_row_finalizes_to_zero():
+    from fluxdistributed_tpu.ops.attention import (
+        attn_block_update,
+        attn_finalize,
+        attn_init,
+    )
+
+    q, k, v = _qkv(t=8)
+    mask = jnp.zeros((8, 8), bool)  # nothing may attend
+    carry = attn_block_update(attn_init(q), q, k, v, mask=mask)
+    out = attn_finalize(carry, q.dtype)
+    np.testing.assert_array_equal(np.asarray(out), 0.0)
+
+
+def test_factory_kwargs_overridable():
+    from fluxdistributed_tpu.models import vit_b16, vit_tiny
+
+    assert vit_b16(patch=32).patch == 32
+    assert vit_tiny(depth=1).depth == 1
+
+
+def test_attention_grads_match():
+    q, k, v = _qkv(t=32)
+
+    def loss_ref(q, k, v):
+        return dot_product_attention(q, k, v, causal=True).sum()
+
+    def loss_blk(q, k, v):
+        return blockwise_attention(q, k, v, block_size=8, causal=True).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_blk = jax.grad(loss_blk, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_blk):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+class TestViT:
+    @pytest.fixture(scope="class")
+    def model_and_vars(self):
+        from fluxdistributed_tpu.models import vit_tiny
+
+        model = vit_tiny(num_classes=10, dtype=jnp.float32)
+        x = jnp.zeros((2, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        return model, variables
+
+    def test_forward_shape(self, model_and_vars):
+        model, variables = model_and_vars
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (2, 10)
+        assert out.dtype == jnp.float32
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_train_step_decreases_loss(self, model_and_vars):
+        from fluxdistributed_tpu import logitcrossentropy, onehot
+        from fluxdistributed_tpu import optim
+
+        model, variables = model_and_vars
+        x = jax.random.normal(jax.random.PRNGKey(2), (8, 32, 32, 3))
+        y = onehot(np.arange(8) % 10, 10)
+        opt = optim.adam(1e-3)
+        params = variables["params"]
+        state = opt.init(params)
+
+        @jax.jit
+        def step(params, state, i):
+            def lf(p):
+                logits = model.apply(
+                    {"params": p}, x, train=True,
+                    rngs={"dropout": jax.random.PRNGKey(i)},
+                )
+                return logitcrossentropy(logits, y)
+
+            loss, g = jax.value_and_grad(lf)(params)
+            params, state = opt.apply(params, g, state, i)
+            return params, state, loss
+
+        params, state, l0 = step(params, state, 0)
+        for i in range(1, 10):
+            params, state, l = step(params, state, i)
+        assert float(l) < float(l0)
+
+    def test_pluggable_attention_changes_nothing(self):
+        """ViT with blockwise attention == ViT with reference attention."""
+        from functools import partial
+
+        from fluxdistributed_tpu.models import vit_tiny
+
+        x = jax.random.normal(jax.random.PRNGKey(3), (2, 32, 32, 3))
+        m_ref = vit_tiny(num_classes=10, dtype=jnp.float32)
+        variables = m_ref.init(jax.random.PRNGKey(0), x, train=False)
+        m_blk = vit_tiny(
+            num_classes=10, dtype=jnp.float32,
+            attn_fn=partial(blockwise_attention, block_size=16),
+        )
+        a = m_ref.apply(variables, x, train=False)
+        b = m_blk.apply(variables, x, train=False)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
