@@ -28,6 +28,7 @@ __all__ = [
     "AttnCarry",
     "attn_block_update",
     "attn_finalize",
+    "online_softmax_update",
 ]
 
 NEG_INF = -1e30
@@ -50,17 +51,28 @@ def dot_product_attention(
     ``q``: [B, Tq, H, D]; ``k``/``v``: [B, Tk, H, D] → [B, Tq, H, D].
     ``mask``: optional [B?, H?, Tq, Tk] additive-compatible boolean mask
     (True = attend).  f32 softmax, output in q.dtype.
+
+    Rows with NO attendable position (all-False mask row, or causal rows
+    before the first key when Tq > Tk) return exactly 0 — the same
+    convention as every other attention implementation in this package.
     """
     q = _scale(q)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    tq, tk = s.shape[-2], s.shape[-1]
+    allow = None
     if causal:
-        tq, tk = s.shape[-2], s.shape[-1]
         # Align ends: allows Tq != Tk (e.g. decoding with a KV cache).
         idx_q = jnp.arange(tq)[:, None] + (tk - tq)
-        s = jnp.where(jnp.arange(tk)[None, :] <= idx_q, s, NEG_INF)
+        allow = (jnp.arange(tk)[None, :] <= idx_q)[None, None]
     if mask is not None:
-        s = jnp.where(mask, s, NEG_INF)
+        allow = mask if allow is None else allow & mask
+    if allow is not None:
+        s = jnp.where(allow, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if allow is not None:
+        # Softmax over an all-NEG_INF row is uniform; zero it so fully-
+        # masked rows output 0, matching blockwise/flash.
+        p = jnp.where(allow, p, 0.0)
     return jnp.einsum(
         "bhqk,bkhd->bqhd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
     ).astype(q.dtype)
@@ -77,6 +89,33 @@ class AttnCarry(NamedTuple):
     o: jax.Array
     m: jax.Array
     l: jax.Array
+
+
+def online_softmax_update(s, m_prev, l_prev, mask=None):
+    """The online-softmax statistics update — THE shared numerics.
+
+    Used by ``attn_block_update`` (XLA blockwise + ring attention) and by
+    the Pallas flash kernel, so the masking/accumulation semantics cannot
+    drift between implementations.
+
+    ``s``: [..., q, k] f32 scores (pre-scaled).  ``m_prev``/``l_prev``:
+    [..., q].  ``mask``: optional [..., q, k] boolean, True = attend.
+    Returns ``(p, corr, m_new, l_new)`` where ``p`` is the un-normalized
+    block softmax (zeroed at masked positions — rows masked everywhere
+    keep ``l == 0`` and finalize to 0) and ``corr`` rescales the caller's
+    output accumulator.
+    """
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m_prev, s.max(axis=-1))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    if mask is not None:
+        # For a row masked in EVERY position so far, m_new is still
+        # NEG_INF and exp(s - m_new) = exp(0) = 1 — zero explicitly.
+        p = jnp.where(mask, p, 0.0)
+    l_new = l_prev * corr + p.sum(axis=-1)
+    return p, corr, m_new, l_new
 
 
 def attn_init(q: jax.Array) -> AttnCarry:
@@ -109,17 +148,9 @@ def attn_block_update(
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q_scaled, k_blk, preferred_element_type=jnp.float32
     )
-    if mask is not None:
-        s = jnp.where(mask[None, None], s, NEG_INF)
-    m_new = jnp.maximum(carry.m, s.max(axis=-1))
-    corr = jnp.exp(carry.m - m_new)
-    p = jnp.exp(s - m_new[..., None])  # [B,H,Tq,Tk]
-    if mask is not None:
-        # For a row masked in EVERY position so far, m_new is still
-        # NEG_INF and exp(s - m_new) = exp(0) = 1 — zero those entries
-        # explicitly so fully-masked rows keep l == 0 and finalize to 0.
-        p = jnp.where(mask[None, None], p, 0.0)
-    l_new = carry.l * corr + p.sum(axis=-1)
+    p, corr, m_new, l_new = online_softmax_update(
+        s, carry.m, carry.l, mask=None if mask is None else mask[None, None]
+    )
     pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
     o_new = carry.o * corr.transpose(0, 2, 1)[..., None] + pv
     return AttnCarry(o=o_new, m=m_new, l=l_new)
