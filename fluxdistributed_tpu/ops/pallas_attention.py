@@ -42,7 +42,7 @@ _LANES = 128
 
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-    *, scale, causal, tk_valid, causal_offset,
+    *, scale, causal, tk_valid, causal_offset, padded,
 ):
     """``causal_offset = Tk_valid - Tq_valid`` end-aligns the causal mask
     (query i attends keys <= i + offset), matching
@@ -70,11 +70,14 @@ def _flash_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
 
-        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = k_pos < tk_valid
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            mask &= k_pos <= q_pos + causal_offset
+        if causal or padded:
+            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            mask = k_pos < tk_valid
+            if causal:
+                q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                mask &= k_pos <= q_pos + causal_offset
+        else:
+            mask = None  # aligned non-causal: skip mask VPU work entirely
 
         p, corr, m_new, l_new = online_softmax_update(
             s, m_ref[:, 0], l_ref[:, 0], mask=mask
@@ -124,7 +127,7 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     grid = (b * h, tq_p // block_q, tk_p // block_k)
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, tk_valid=tk,
-        causal_offset=tk - tq,
+        causal_offset=tk - tq, padded=tk_p != tk,
     )
     out = pl.pallas_call(
         kernel,

@@ -1,6 +1,13 @@
 from . import multihost
 from .collectives import pmean, psum, all_gather, reduce_scatter, ppermute_ring
+from .context import (
+    make_ring_attention,
+    make_ulysses_attention,
+    ring_attention,
+    ulysses_attention,
+)
 from .dp import TrainState, make_train_step, make_eval_step, make_train_step_shardmap
+from .tp import make_train_step_tp, param_specs, shard_state, vit_tp_rules
 
 __all__ = [
     "multihost",
@@ -13,4 +20,12 @@ __all__ = [
     "make_train_step",
     "make_eval_step",
     "make_train_step_shardmap",
+    "ring_attention",
+    "make_ring_attention",
+    "ulysses_attention",
+    "make_ulysses_attention",
+    "make_train_step_tp",
+    "param_specs",
+    "shard_state",
+    "vit_tp_rules",
 ]

@@ -1,0 +1,176 @@
+"""Context/sequence parallelism: ring attention + Ulysses all-to-all.
+
+Net-new scope beyond the reference (vision-CNN-only, SURVEY §5
+"long-context: absent"), built first-class for TPU: long sequences are
+sharded across a ``seq`` mesh axis and attention runs without ever
+gathering the full sequence on one device.
+
+Two strategies, both SPMD inside ``shard_map``:
+
+* **Ring attention** (`ring_attention`): each device holds one Q shard
+  and rotates KV shards around the ring with ``ppermute`` (one ICI hop
+  per step), folding each arriving KV block into the shared
+  online-softmax accumulator (``ops.attention.attn_block_update``) —
+  compute overlaps the next hop's transfer, memory is O(T/P), and the
+  numerics are bit-for-bit those of ``blockwise_attention``.
+* **Ulysses** (`ulysses_attention`): two ``all_to_all``s re-shard
+  [seq-sharded, all heads] ↔ [all seq, head-sharded]; attention itself
+  is a dense local op on full sequences for H/P heads.  Cheaper at
+  moderate sequence lengths (2 collectives instead of P hops); requires
+  ``num_heads % P == 0``.
+
+Use the ``make_*`` wrappers to get an ``attn_fn`` pluggable directly
+into ``models.vit.ViT(attn_fn=...)`` — model code does not change when
+the sequence axis is sharded.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import (
+    _scale,
+    attn_block_update,
+    attn_finalize,
+    attn_init,
+)
+
+__all__ = [
+    "ring_attention",
+    "make_ring_attention",
+    "ulysses_attention",
+    "make_ulysses_attention",
+]
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+) -> jax.Array:
+    """Ring attention over sequence shards.  Call inside ``shard_map``.
+
+    ``q``/``k``/``v`` are the LOCAL shards [B, T/P, H, D] of a sequence
+    sharded on mesh axis ``axis_name``; returns the local output shard.
+    Causal masking uses global positions (shard i owns tokens
+    [i·T/P, (i+1)·T/P)).
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    q_scaled = _scale(q)
+    q_pos = my_idx * t_local + jnp.arange(t_local)
+
+    # Send KV to the next rank each hop → after i hops this device holds
+    # the KV shard originally owned by rank (my_idx - i) mod P.
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def hop(i, state):
+        carry, k_cur, v_cur = state
+        blk = (my_idx - i) % axis_size
+        mask = None
+        if causal:
+            k_pos = blk * t_local + jnp.arange(t_local)
+            mask = k_pos[None, :] <= q_pos[:, None]
+        carry = attn_block_update(carry, q_scaled, k_cur, v_cur, mask=mask)
+        # One more rotation than strictly needed on the last hop would
+        # waste a transfer; guard via cond-free arithmetic is not worth
+        # it — XLA overlaps the permute with the block compute.
+        k_cur, v_cur = jax.lax.ppermute((k_cur, v_cur), axis_name, perm)
+        return carry, k_cur, v_cur
+
+    carry, _, _ = jax.lax.fori_loop(0, axis_size, hop, (attn_init(q), k, v))
+    return attn_finalize(carry, q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    seq_axis: str = "seq",
+    batch_axis: Optional[str] = None,
+    causal: bool = False,
+):
+    """Wrap ``ring_attention`` in ``shard_map`` → a drop-in ``attn_fn``.
+
+    Takes/returns global [B, T, H, D] arrays with T sharded on
+    ``seq_axis`` (and optionally B on ``batch_axis``); composes under an
+    outer ``jit`` so a ViT built with this attn_fn trains data- AND
+    sequence-parallel from one compiled program.
+    """
+    spec = P(batch_axis, seq_axis)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    def attn(q, k, v):
+        return ring_attention(q, k, v, seq_axis, causal=causal)
+
+    return attn
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+) -> jax.Array:
+    """Ulysses sequence parallelism: all-to-all heads↔sequence re-shard.
+
+    Call inside ``shard_map`` with local shards [B, T/P, H, D]; requires
+    H divisible by the axis size.  Attention itself runs dense on the
+    full sequence for H/P heads (``blockwise_attention`` would also work;
+    dense is fastest at the moderate T where Ulysses wins).
+    """
+    from ..ops.attention import dot_product_attention
+
+    axis_size = jax.lax.psum(1, axis_name)
+    assert q.shape[2] % axis_size == 0, (
+        f"'{axis_name}' axis size {axis_size} must divide num_heads {q.shape[2]}"
+    )
+    # [B, T/P, H, D] → [B, T, H/P, D]
+    gather = partial(
+        jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
+        tiled=True,
+    )
+    qg, kg, vg = gather(q), gather(k), gather(v)
+    out = dot_product_attention(qg, kg, vg, causal=causal)
+    # [B, T, H/P, D] → [B, T/P, H, D]
+    return jax.lax.all_to_all(
+        out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def make_ulysses_attention(
+    mesh: Mesh,
+    seq_axis: str = "seq",
+    batch_axis: Optional[str] = None,
+    causal: bool = False,
+):
+    """``shard_map`` wrapper for ``ulysses_attention`` (see
+    ``make_ring_attention``)."""
+    spec = P(batch_axis, seq_axis)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    def attn(q, k, v):
+        return ulysses_attention(q, k, v, seq_axis, causal=causal)
+
+    return attn

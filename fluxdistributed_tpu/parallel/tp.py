@@ -1,0 +1,176 @@
+"""Tensor parallelism: parameter sharding over a ``model`` mesh axis.
+
+Net-new scope beyond the reference (whole-replica models only —
+``gpu(resnet)`` src/ddp_tasks.jl:275; SURVEY §2 "TP: NO"), built the
+TPU-idiomatic way: params get ``NamedSharding``s over a 2-D
+``(data, model)`` mesh and GSPMD inserts the collectives — there is no
+hand-written all-gather/reduce-scatter in the training step.  The same
+``TrainState``/optimizer/loss machinery as the DP path is reused; TP is
+purely a placement change.
+
+Sharding rules follow the Megatron pattern for transformers: QKV
+projection column-sharded over heads, attention output row-sharded, MLP
+up-projection column-sharded, down-projection row-sharded — so each
+block needs exactly two all-reduces (inserted automatically as the
+transpose of the row-sharded matmuls).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import mesh as mesh_lib
+from ..optim import Optimizer
+from .dp import TrainState
+
+Pytree = Any
+
+__all__ = [
+    "param_specs",
+    "broadcast_prefix",
+    "state_specs",
+    "shard_state",
+    "vit_tp_rules",
+    "make_train_step_tp",
+]
+
+
+def _is_spec(x) -> bool:
+    return isinstance(x, P)
+
+
+def param_specs(params: Pytree, rule: Callable[[str, Any], P]) -> Pytree:
+    """Build a PartitionSpec tree by applying ``rule(path, leaf)`` to
+    every param leaf.  ``path`` is '/'-joined (e.g.
+    ``block0/MultiHeadAttention_0/qkv/kernel``)."""
+
+    def f(kp, leaf):
+        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
+        return rule(path, leaf)
+
+    return jax.tree_util.tree_map_with_path(f, params)
+
+
+def broadcast_prefix(specs: Pytree, tree: Pytree) -> Pytree:
+    """Broadcast a prefix tree of PartitionSpecs over a deeper tree.
+
+    Optimizer states mirror the param tree but may nest extra structure
+    per param (Adam's ``(m, v)`` tuples); each param's spec is applied to
+    every array in its state subtree.
+    """
+    treedef = jax.tree.structure(specs, is_leaf=_is_spec)
+    subtrees = treedef.flatten_up_to(tree)
+    leaves = jax.tree.leaves(specs, is_leaf=_is_spec)
+    mapped = [jax.tree.map(lambda _, s=s: s, sub) for s, sub in zip(leaves, subtrees)]
+    return jax.tree.unflatten(treedef, mapped)
+
+
+def state_specs(state: TrainState, p_specs: Pytree) -> TrainState:
+    """Spec tree matching a ``TrainState``: params per ``p_specs``, opt
+    state following its param, everything else replicated."""
+    return TrainState(
+        params=p_specs,
+        opt_state=broadcast_prefix(p_specs, state.opt_state),
+        model_state=jax.tree.map(lambda _: P(), state.model_state),
+        step=P(),
+    )
+
+
+def shard_state(state: TrainState, mesh: Mesh, p_specs: Pytree) -> TrainState:
+    """``device_put`` a TrainState onto the mesh per the spec tree.
+
+    Leaves are copied first (``sharding.unaliased``) so donating the
+    sharded state cannot delete the caller's source arrays."""
+    from ..sharding import unaliased
+
+    specs = state_specs(state, p_specs)
+
+    def put(x, s):
+        if x is None:
+            return None
+        return jax.device_put(unaliased(x), NamedSharding(mesh, s))
+
+    return jax.tree.map(put, state, specs, is_leaf=lambda x: x is None)
+
+
+def vit_tp_rules(model_axis: str = "model") -> Callable[[str, Any], P]:
+    """Megatron-style sharding rules for ``models.vit.ViT`` param paths.
+
+    qkv kernel  [dim, 3, heads, head_dim] → heads sharded (column)
+    out kernel  [heads, head_dim, dim]    → heads sharded (row)
+    MLP Dense_0 [dim, mlp_dim]            → mlp_dim sharded (column)
+    MLP Dense_1 [mlp_dim, dim]            → mlp_dim sharded (row)
+    Everything else (norms, patch embed, head, biases of row-sharded
+    layers) replicated.
+    """
+
+    def rule(path: str, leaf) -> P:
+        if path.endswith("qkv/kernel"):
+            return P(None, None, model_axis, None)
+        if path.endswith("qkv/bias"):
+            return P(None, model_axis, None)
+        if path.endswith("out/kernel"):
+            return P(model_axis, None, None)
+        if "MlpBlock" in path and path.endswith("Dense_0/kernel"):
+            return P(None, model_axis)
+        if "MlpBlock" in path and path.endswith("Dense_0/bias"):
+            return P(model_axis)
+        if "MlpBlock" in path and path.endswith("Dense_1/kernel"):
+            return P(model_axis, None)
+        return P()
+
+    return rule
+
+
+def make_train_step_tp(
+    loss_fn: Callable,
+    optimizer: Optimizer,
+    mesh: Mesh,
+    p_specs: Pytree,
+    state: TrainState,
+    data_axis: str = mesh_lib.DATA_AXIS,
+    donate: bool = True,
+):
+    """Compile a train step with tensor-parallel parameter shardings.
+
+    Identical step semantics to ``make_train_step`` (global-batch mean
+    loss → implicit grad all-reduce → functional optimizer update); only
+    the shardings differ: params/opt-state per ``p_specs`` over the
+    ``model`` axis, batch over ``data_axis``.  ``state`` is needed only
+    for its tree structure (to spec the optimizer state).
+    """
+    specs = state_specs(state, p_specs)
+    to_shardings = lambda t: jax.tree.map(
+        lambda s: NamedSharding(mesh, s), t, is_leaf=_is_spec
+    )
+    state_shardings = to_shardings(specs)
+    batch_sharding = NamedSharding(mesh, P(data_axis))
+
+    def step(state: TrainState, batch):
+        def lossf(params):
+            return loss_fn(params, state.model_state, batch, True)
+
+        (loss, (new_mstate, _)), grads = jax.value_and_grad(lossf, has_aux=True)(
+            state.params
+        )
+        new_params, new_opt = optimizer.apply(
+            state.params, grads, state.opt_state, state.step
+        )
+        new_state = TrainState(
+            params=new_params,
+            opt_state=new_opt,
+            model_state=new_mstate,
+            step=state.step + 1,
+        )
+        return new_state, {"loss": loss}
+
+    return jax.jit(
+        step,
+        in_shardings=(state_shardings, batch_sharding),
+        out_shardings=(state_shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,) if donate else (),
+    )

@@ -47,6 +47,17 @@ def batch_spec(axis: str = mesh_lib.DATA_AXIS) -> P:
     return P(axis)
 
 
+def unaliased(x):
+    """Copy a ``jax.Array`` so a subsequent ``device_put``'s output shares
+    no buffer with the caller's array.  ``device_put`` is zero-copy when
+    source and target share a device, and train states built from the
+    result are *donated* into the compiled step — donation of an aliased
+    buffer would delete the caller's array out from under them."""
+    import jax.numpy as jnp
+
+    return jnp.array(x, copy=True) if isinstance(x, jax.Array) else x
+
+
 def replicate(tree: Pytree, mesh: Mesh) -> Pytree:
     """Place a full copy of every leaf on every mesh device.
 
@@ -55,7 +66,7 @@ def replicate(tree: Pytree, mesh: Mesh) -> Pytree:
     of N copies.
     """
     s = replicated(mesh)
-    return jax.tree.map(lambda x: jax.device_put(x, s), tree)
+    return jax.tree.map(lambda x: jax.device_put(unaliased(x), s), tree)
 
 
 def shard_batch(batch: Pytree, mesh: Mesh, axis: str = mesh_lib.DATA_AXIS) -> Pytree:

@@ -52,6 +52,15 @@ def test_fully_masked_row_is_zero_everywhere():
     ref = dot_product_attention(q, k, v, mask=mask)
     assert np.abs(np.asarray(ref[:, 3])).max() == 0.0
 
+    # Flash kernel path: causal with Tq > Tk leaves the leading rows with
+    # no attendable key (end-aligned) — they must be exactly 0, not NaN.
+    q8, _, _ = _qkv(t=8, seed=2)
+    _, k4, v4 = _qkv(t=4, seed=3)
+    out = flash_attention(q8, k4, v4, True, 4, 4)
+    ref2 = dot_product_attention(q8, k4, v4, causal=True)
+    assert np.abs(np.asarray(out[:, :4])).max() == 0.0
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref2), rtol=2e-5, atol=2e-5)
+
 
 def test_flash_bf16():
     q, k, v = _qkv(dtype=jnp.bfloat16)
