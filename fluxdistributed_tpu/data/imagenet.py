@@ -136,6 +136,7 @@ class ImageNetDataset:
         resize: int = 256,
         compat_double_normalize: bool = False,
         num_threads: int = 8,
+        use_native: Optional[bool] = None,
     ):
         self.root = root
         self.table = table
@@ -145,6 +146,11 @@ class ImageNetDataset:
         self.compat = compat_double_normalize
         self._num_threads = num_threads
         self._pool = None  # created lazily, released by close()
+        if use_native is None:
+            from . import native as _native
+
+            use_native = _native.available()
+        self.use_native = use_native
 
     def __len__(self):
         return len(self.table)
@@ -176,6 +182,29 @@ class ImageNetDataset:
         if indices is None:
             indices = rng.integers(0, len(self.table), size=n)
         indices = np.asarray(indices)
+        if self.use_native:
+            from . import native as _native
+
+            paths = [
+                makepaths(self.table.image_ids[j], self.root, self.table.split)
+                for j in indices
+            ]
+            # PIL fallback per file: ImageNet hides a few PNG/odd-format
+            # files behind .JPEG extensions that libjpeg rejects.
+            arr = _native.load_batch(
+                paths,
+                crop=self.crop,
+                resize=self.resize,
+                compat_double_normalize=self.compat,
+                num_threads=self._num_threads,
+                fallback=lambda p: preprocess(
+                    p,
+                    crop=self.crop,
+                    resize=self.resize,
+                    compat_double_normalize=self.compat,
+                ),
+            )
+            return arr, self.table.class_idx[indices]
         if self._pool is None:
             self._pool = ThreadPoolExecutor(max_workers=self._num_threads)
         arr = np.zeros((len(indices), self.crop, self.crop, 3), np.float32)

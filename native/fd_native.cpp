@@ -1,0 +1,352 @@
+// fd_native — native image-ingest pipeline for fluxdistributed_tpu.
+//
+// TPU-native re-implementation of the reference's host-side data path
+// (src/imagenet.jl:28-48 fproc + src/preprocess.jl:30-67), which leans on
+// libjpeg-turbo (JpegTurbo.jl) and ImageMagick/Images.jl native code and
+// runs one Julia thread per image.  Here the whole hot path — file read,
+// JPEG decode (libjpeg), antialiased resize, center crop, normalize —
+// is C++ behind a C ABI, with an internal std::thread pool per batch.
+// Python binds via ctypes (no pybind11 in the image); the GIL is
+// released for the whole batch call.
+//
+// API (all functions return 0 on success unless noted):
+//   fd_version()                     -> int version
+//   fd_preprocess_rgb(...)           -> resize+crop+normalize one RGB image
+//   fd_load_batch(paths, n, ...)     -> full pipeline for n files, threaded
+//   fd_decode_jpeg_file(path, ...)   -> decode only (caller frees via fd_free)
+//
+// Layout: outputs are float32 HWC (NHWC once batched) — the TPU-native
+// layout (the reference's WHCN permute is a Julia memory-order artifact).
+
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+int fd_version() { return 2; }
+
+void fd_free(void* p) { std::free(p); }
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// JPEG decode (libjpeg with longjmp error handler — the library's default
+// error handler exit()s the process, unacceptable in a training job).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+  char msg[JMSG_LENGTH_MAX];
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* e = reinterpret_cast<JpegErr*>(cinfo->err);
+  (*cinfo->err->format_message)(cinfo, e->msg);
+  longjmp(e->jb, 1);
+}
+
+// Decode JPEG bytes to RGB8. Returns malloc'd buffer (h*w*3) or nullptr.
+//
+// Locals touched after setjmp are raw pointers declared `volatile` (a
+// non-volatile local modified between setjmp and longjmp is indeterminate
+// after the jump), and cleanup uses free() only — no destructors are
+// skipped by the longjmp.  CMYK/YCCK (Adobe) sources are decoded as
+// JCS_CMYK and converted here — libjpeg cannot emit RGB for them, and
+// ImageNet is known to contain a handful of such files.
+uint8_t* decode_jpeg(const uint8_t* buf, size_t len, int* h, int* w,
+                     std::string* err) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  uint8_t* volatile out = nullptr;
+  uint8_t* volatile rowbuf = nullptr;
+  if (setjmp(jerr.jb)) {
+    if (err) *err = jerr.msg;
+    jpeg_destroy_decompress(&cinfo);
+    std::free(out);
+    std::free(rowbuf);
+    return nullptr;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf), len);
+  jpeg_read_header(&cinfo, TRUE);
+  const bool cmyk = cinfo.jpeg_color_space == JCS_CMYK ||
+                    cinfo.jpeg_color_space == JCS_YCCK;
+  cinfo.out_color_space = cmyk ? JCS_CMYK : JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const int W = cinfo.output_width, H = cinfo.output_height;
+  const int C = cinfo.output_components;  // 3 (RGB) or 4 (CMYK)
+  out = static_cast<uint8_t*>(std::malloc(size_t(W) * H * 3));
+  rowbuf = static_cast<uint8_t*>(std::malloc(size_t(W) * C));
+  if (!out || !rowbuf) {
+    if (err) *err = "malloc failed";
+    jpeg_destroy_decompress(&cinfo);
+    std::free(out);
+    std::free(rowbuf);
+    return nullptr;
+  }
+  JSAMPROW rp = rowbuf;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    int y = cinfo.output_scanline;
+    jpeg_read_scanlines(&cinfo, &rp, 1);
+    uint8_t* dst = out + size_t(y) * W * 3;
+    if (cmyk) {
+      // Adobe stores inverted CMYK: RGB = (C,M,Y) scaled by K.
+      for (int x = 0; x < W; ++x) {
+        const uint8_t* p = rowbuf + size_t(x) * 4;
+        const int k = p[3];
+        dst[3 * x] = uint8_t(p[0] * k / 255);
+        dst[3 * x + 1] = uint8_t(p[1] * k / 255);
+        dst[3 * x + 2] = uint8_t(p[2] * k / 255);
+      }
+    } else if (C == 3) {
+      std::memcpy(dst, rowbuf, size_t(W) * 3);
+    } else {  // defensive: expand single channel
+      for (int x = 0; x < W; ++x)
+        dst[3 * x] = dst[3 * x + 1] = dst[3 * x + 2] = rowbuf[size_t(x) * C];
+    }
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  std::free(rowbuf);
+  *h = H;
+  *w = W;
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Antialiased separable resize (triangle/linear filter, support scaled by
+// the reduction factor — the same family Pillow's BILINEAR uses, and the
+// functional equivalent of the reference's Gaussian-lowpass-then-imresize,
+// src/preprocess.jl:30-42).  float32 intermediates.
+// ---------------------------------------------------------------------------
+
+struct FilterTaps {
+  std::vector<int> first;     // first source index per output pixel
+  std::vector<int> count;     // tap count per output pixel
+  std::vector<float> weight;  // taps, row-major [out, maxcount]
+  int maxcount = 0;
+};
+
+FilterTaps build_taps(int in_size, int out_size) {
+  FilterTaps t;
+  const double scale = double(in_size) / out_size;
+  const double support = std::max(1.0, scale);  // widen when downscaling
+  t.maxcount = int(std::ceil(support)) * 2 + 1;
+  t.first.resize(out_size);
+  t.count.resize(out_size);
+  t.weight.assign(size_t(out_size) * t.maxcount, 0.f);
+  for (int o = 0; o < out_size; ++o) {
+    const double center = (o + 0.5) * scale;
+    int lo = std::max(0, int(std::floor(center - support)));
+    int hi = std::min(in_size, int(std::ceil(center + support)));
+    double sum = 0;
+    int cnt = hi - lo;
+    for (int i = 0; i < cnt; ++i) {
+      double x = (lo + i + 0.5 - center) / support;  // triangle filter
+      double wv = std::max(0.0, 1.0 - std::fabs(x));
+      t.weight[size_t(o) * t.maxcount + i] = float(wv);
+      sum += wv;
+    }
+    if (sum > 0)
+      for (int i = 0; i < cnt; ++i)
+        t.weight[size_t(o) * t.maxcount + i] /= float(sum);
+    t.first[o] = lo;
+    t.count[o] = cnt;
+  }
+  return t;
+}
+
+// uint8 HWC RGB → float32 HWC resized (nh, nw).
+void resize_rgb(const uint8_t* src, int h, int w, float* dst, int nh, int nw) {
+  FilterTaps tx = build_taps(w, nw), ty = build_taps(h, nh);
+  // horizontal pass: (h, w, 3) u8 → (h, nw, 3) f32
+  std::vector<float> tmp(size_t(h) * nw * 3);
+  for (int y = 0; y < h; ++y) {
+    const uint8_t* row = src + size_t(y) * w * 3;
+    float* orow = tmp.data() + size_t(y) * nw * 3;
+    for (int o = 0; o < nw; ++o) {
+      const float* wt = &tx.weight[size_t(o) * tx.maxcount];
+      const int f = tx.first[o], c = tx.count[o];
+      float r = 0, g = 0, b = 0;
+      for (int i = 0; i < c; ++i) {
+        const uint8_t* p = row + size_t(f + i) * 3;
+        r += wt[i] * p[0];
+        g += wt[i] * p[1];
+        b += wt[i] * p[2];
+      }
+      orow[3 * o] = r;
+      orow[3 * o + 1] = g;
+      orow[3 * o + 2] = b;
+    }
+  }
+  // vertical pass: (h, nw, 3) → (nh, nw, 3)
+  for (int o = 0; o < nh; ++o) {
+    const float* wt = &ty.weight[size_t(o) * ty.maxcount];
+    const int f = ty.first[o], c = ty.count[o];
+    float* orow = dst + size_t(o) * nw * 3;
+    std::memset(orow, 0, size_t(nw) * 3 * sizeof(float));
+    for (int i = 0; i < c; ++i) {
+      const float* irow = tmp.data() + size_t(f + i) * nw * 3;
+      const float wv = wt[i];
+      for (int x = 0; x < nw * 3; ++x) orow[x] += wv * irow[x];
+    }
+  }
+}
+
+// resize smallest side → `resize`, center-crop `crop`, normalize.
+// out: crop*crop*3 float32.  compat = reference double-normalize quirk.
+void preprocess_rgb(const uint8_t* rgb, int h, int w, int resize, int crop,
+                    const float* mean, const float* stdv, int compat,
+                    float* out) {
+  const double scale = double(resize) / std::min(h, w);
+  int nh = std::max(resize, int(std::lround(h * scale)));
+  int nw = std::max(resize, int(std::lround(w * scale)));
+  std::vector<float> resized(size_t(nh) * nw * 3);
+  if (nh == h && nw == w) {
+    for (size_t i = 0; i < resized.size(); ++i) resized[i] = float(rgb[i]);
+  } else {
+    resize_rgb(rgb, h, w, resized.data(), nh, nw);
+  }
+  const int top = (nh - crop) / 2, left = (nw - crop) / 2;
+  const float inv255 = 1.f / 255.f;
+  for (int y = 0; y < crop; ++y) {
+    const float* srow = resized.data() + (size_t(top + y) * nw + left) * 3;
+    float* drow = out + size_t(y) * crop * 3;
+    for (int x = 0; x < crop; ++x) {
+      for (int ch = 0; ch < 3; ++ch) {
+        float v = srow[3 * x + ch] * inv255;
+        drow[3 * x + ch] = (v - mean[ch]) / stdv[ch];
+      }
+    }
+  }
+  if (compat) {
+    // Reference quirk (src/preprocess.jl:66 + src/imagenet.jl:34):
+    // *255 then per-image standardization.
+    const size_t n = size_t(crop) * crop * 3;
+    double s = 0;
+    for (size_t i = 0; i < n; ++i) {
+      out[i] *= 255.f;
+      s += out[i];
+    }
+    const double m = s / n;
+    double var = 0;
+    for (size_t i = 0; i < n; ++i) {
+      const double d = out[i] - m;
+      var += d * d;
+    }
+    // match numpy std (population) + the Python path's 1e-5 epsilon
+    const float sd = float(std::sqrt(var / n)) + 1e-5f;
+    for (size_t i = 0; i < n; ++i) out[i] = (out[i] - float(m)) / sd;
+  }
+}
+
+bool read_file(const char* path, std::vector<uint8_t>* buf) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long n = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (n < 0) {
+    std::fclose(f);
+    return false;
+  }
+  buf->resize(size_t(n));
+  size_t rd = n ? std::fread(buf->data(), 1, size_t(n), f) : 0;
+  std::fclose(f);
+  return rd == size_t(n);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode + preprocess one in-memory RGB image.
+int fd_preprocess_rgb(const uint8_t* rgb, int h, int w, int resize, int crop,
+                      const float* mean, const float* stdv, int compat,
+                      float* out) {
+  if (!rgb || !out || h < 1 || w < 1 || crop > resize) return 1;
+  preprocess_rgb(rgb, h, w, resize, crop, mean, stdv, compat, out);
+  return 0;
+}
+
+// Decode a JPEG file; *out is malloc'd (free with fd_free).
+int fd_decode_jpeg_file(const char* path, uint8_t** out, int* h, int* w) {
+  std::vector<uint8_t> buf;
+  if (!read_file(path, &buf)) return 1;
+  std::string err;
+  uint8_t* rgb = decode_jpeg(buf.data(), buf.size(), h, w, &err);
+  if (!rgb) return 2;
+  *out = rgb;
+  return 0;
+}
+
+// Full batch pipeline: n files → out (n, crop, crop, 3) float32.
+// Threaded with an atomic work queue.  Returns the number of failed
+// images (their slots are zero-filled and flagged in `failed` when
+// non-null, so the caller can re-load them through a fallback decoder);
+// errbuf holds the first error.
+int fd_load_batch(const char** paths, int n, int resize, int crop,
+                  const float* mean, const float* stdv, int compat,
+                  float* out, int nthreads, char* errbuf, int errlen,
+                  unsigned char* failed) {
+  if (n <= 0) return 0;
+  nthreads = std::max(1, std::min(nthreads, n));
+  std::atomic<int> next(0), failures(0);
+  std::atomic<bool> have_err(false);
+  const size_t stride = size_t(crop) * crop * 3;
+  auto worker = [&]() {
+    for (;;) {
+      const int i = next.fetch_add(1);
+      if (i >= n) return;
+      float* dst = out + size_t(i) * stride;
+      std::vector<uint8_t> buf;
+      std::string err;
+      uint8_t* rgb = nullptr;
+      int h = 0, w = 0;
+      if (!read_file(paths[i], &buf)) {
+        err = std::string("cannot read ") + paths[i];
+      } else {
+        rgb = decode_jpeg(buf.data(), buf.size(), &h, &w, &err);
+      }
+      if (!rgb) {
+        std::memset(dst, 0, stride * sizeof(float));
+        if (failed) failed[i] = 1;
+        failures.fetch_add(1);
+        if (!have_err.exchange(true) && errbuf && errlen > 0) {
+          std::snprintf(errbuf, size_t(errlen), "%s: %s", paths[i],
+                        err.c_str());
+        }
+        continue;
+      }
+      if (failed) failed[i] = 0;
+      preprocess_rgb(rgb, h, w, resize, crop, mean, stdv, compat, dst);
+      std::free(rgb);
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 1; t < nthreads; ++t) pool.emplace_back(worker);
+  worker();
+  for (auto& th : pool) th.join();
+  return failures.load();
+}
+
+}  // extern "C"

@@ -1,0 +1,158 @@
+"""Native C++ image-ingest pipeline vs the Python/PIL reference path.
+
+The invariant mirrors the reference's data-path behavior (SURVEY §3.4):
+decode → resize-smallest-side → center-crop → normalize must produce the
+same training distribution whichever backend runs it.  The no-resize path
+must match the Python path exactly; the antialiased resize may differ
+from PIL by sub-pixel-level amounts (different but equivalent filters —
+the reference itself swaps Gaussian-lowpass+imresize for whatever
+Images.jl does, src/preprocess.jl:30-42).
+"""
+
+import importlib
+import os
+
+import numpy as np
+import pytest
+
+from fluxdistributed_tpu.data import native
+
+pp = importlib.import_module("fluxdistributed_tpu.data.preprocess")
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain/libjpeg unavailable"
+)
+
+
+@pytest.fixture(scope="module")
+def img():
+    rng = np.random.default_rng(0)
+    grad = np.linspace(0, 255, 300)[:, None, None]
+    return np.clip(grad + rng.normal(0, 25, (300, 400, 3)), 0, 255).astype(np.uint8)
+
+
+@pytest.fixture(scope="module")
+def jpeg_dir(tmp_path_factory, img):
+    from PIL import Image
+
+    d = tmp_path_factory.mktemp("jpegs")
+    paths = []
+    for i in range(8):
+        p = str(d / f"im{i}.jpg")
+        Image.fromarray(np.roll(img, i * 7, axis=1)).save(p, quality=95)
+        paths.append(p)
+    return paths
+
+
+def test_no_resize_path_matches_python_exactly(img):
+    sq = img[:224, :224]
+    a = native.preprocess_rgb(sq, crop=224, resize=224)
+    b = pp.preprocess(sq, crop=224, resize=224)
+    np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_resize_path_close_to_pil(img):
+    a = native.preprocess_rgb(img)
+    b = pp.preprocess(img)
+    d = np.abs(a - b)
+    # normalized units; 0.02 ≈ 1 pixel level (1/255 / 0.225)
+    assert d.mean() < 0.02 and np.percentile(d, 99) < 0.06
+
+
+def test_compat_double_normalize(img):
+    a = native.preprocess_rgb(img, compat_double_normalize=True)
+    b = pp.preprocess(img, compat_double_normalize=True)
+    assert np.abs(a - b).mean() < 0.05
+    # quirk output is per-image standardized
+    assert abs(a.mean()) < 1e-3 and abs(a.std() - 1) < 1e-2
+
+
+def test_decode_jpeg_file(jpeg_dir):
+    from PIL import Image
+
+    rgb = native.decode_jpeg_file(jpeg_dir[0])
+    assert rgb.shape == (300, 400, 3) and rgb.dtype == np.uint8
+    # both decoders sit on libjpeg → bit-identical output
+    pil = np.asarray(Image.open(jpeg_dir[0]).convert("RGB"))
+    np.testing.assert_array_equal(rgb, pil)
+
+
+def test_load_batch_matches_per_image_pipeline(jpeg_dir):
+    out = native.load_batch(jpeg_dir, num_threads=4)
+    assert out.shape == (len(jpeg_dir), 224, 224, 3)
+    ref = np.stack([pp.preprocess(p) for p in jpeg_dir])
+    assert np.abs(out - ref).mean() < 0.02
+
+
+def test_cmyk_jpeg_decodes(tmp_path, img):
+    """ImageNet contains a few CMYK JPEGs; libjpeg can't emit RGB for
+    them, so the native decoder converts explicitly."""
+    from PIL import Image
+
+    p = str(tmp_path / "cmyk.jpg")
+    Image.fromarray(img).convert("CMYK").save(p, quality=95)
+    rgb = native.decode_jpeg_file(p)
+    pil = np.asarray(Image.open(p).convert("RGB"))
+    assert rgb.shape == pil.shape
+    # different CMYK→RGB roundings; stay within a couple of levels
+    assert np.abs(rgb.astype(int) - pil.astype(int)).mean() < 3
+
+
+def test_load_batch_fallback_handles_png_disguised_as_jpeg(jpeg_dir, tmp_path, img):
+    """PNG bytes behind a .JPEG extension (real ImageNet quirk) must go
+    through the Python fallback instead of poisoning the batch."""
+    import importlib
+
+    from PIL import Image
+
+    ppm = importlib.import_module("fluxdistributed_tpu.data.preprocess")
+    png = str(tmp_path / "sneaky.JPEG")
+    Image.fromarray(img).save(png, format="PNG")
+    out = native.load_batch([jpeg_dir[0], png], fallback=lambda p: ppm.preprocess(p))
+    ref = ppm.preprocess(png)
+    np.testing.assert_allclose(out[1], ref, atol=1e-5)
+
+
+def test_load_batch_rejects_noncontiguous_out(jpeg_dir):
+    big = np.empty((len(jpeg_dir), 224, 224, 6), np.float32)
+    view = big[..., ::2]  # right shape/dtype, wrong strides
+    with pytest.raises(ValueError, match="C-contiguous"):
+        native.load_batch(jpeg_dir, out=view)
+
+
+def test_load_batch_strict_raises_on_corrupt(jpeg_dir, tmp_path):
+    bad = str(tmp_path / "bad.jpg")
+    with open(bad, "wb") as f:
+        f.write(b"not a jpeg at all")
+    with pytest.raises(ValueError, match="failed to load"):
+        native.load_batch([jpeg_dir[0], bad])
+    out = native.load_batch([jpeg_dir[0], bad], strict=False)
+    assert np.abs(out[1]).max() == 0.0  # zero-filled slot
+    assert np.abs(out[0]).max() > 0.0  # good slot intact
+
+
+def test_imagenet_dataset_uses_native(tmp_path, img):
+    """ImageNetDataset(use_native=True) produces the same batches as the
+    PIL path for the same indices."""
+    from PIL import Image
+
+    from fluxdistributed_tpu.data.imagenet import ImageNetDataset, SampleTable
+
+    root = tmp_path
+    d = root / "ILSVRC" / "Data" / "CLS-LOC" / "train" / "n01440764"
+    os.makedirs(d)
+    ids = []
+    for i in range(4):
+        iid = f"n01440764_{i}"
+        Image.fromarray(np.roll(img, i * 11, axis=0)).save(
+            str(d / f"{iid}.JPEG"), quality=95
+        )
+        ids.append(iid)
+    table = SampleTable(np.asarray(ids, object), np.zeros(4, np.int32))
+    ds_nat = ImageNetDataset(str(root), table, nclasses=1, use_native=True)
+    ds_py = ImageNetDataset(str(root), table, nclasses=1, use_native=False)
+    idx = np.array([0, 2, 3])
+    a, la = ds_nat.batch(np.random.default_rng(0), 3, indices=idx)
+    b, lb = ds_py.batch(np.random.default_rng(0), 3, indices=idx)
+    np.testing.assert_array_equal(la, lb)
+    assert np.abs(a - b).mean() < 0.02
