@@ -1,8 +1,24 @@
+from .convnext import (
+    ConvNeXt,
+    convnext_base,
+    convnext_large,
+    convnext_small,
+    convnext_test,
+    convnext_tiny,
+    convnext_xlarge,
+)
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
 from .simple import SimpleCNN, MLP
 from .vit import ViT, vit_tiny, vit_b16, vit_l16, vit_h14
 
 __all__ = [
+    "ConvNeXt",
+    "convnext_test",
+    "convnext_tiny",
+    "convnext_small",
+    "convnext_base",
+    "convnext_large",
+    "convnext_xlarge",
     "ResNet",
     "resnet18",
     "resnet34",

@@ -1,0 +1,159 @@
+"""ConvNeXt family — net-new model scope beyond the reference.
+
+The reference ships Metalhead ResNets only (README.md:27); ConvNeXt-XL
+large-batch LARS training is one of this framework's BASELINE configs
+(BASELINE.json "configs").  Built TPU-first:
+
+* NHWC throughout; the 7×7 depthwise conv maps to XLA's grouped
+  convolution (feature_group_count = channels), the 1×1 "pointwise"
+  MLP convs are plain Dense layers on the channel axis → pure MXU
+  matmuls over (B·H·W, C);
+* bf16 compute / f32 params; LayerNorm statistics in f32;
+* stochastic depth via a per-sample keep mask (shape-static, jit-safe:
+  ``nn.Dropout`` broadcast over all but the batch dim — no Python
+  branching on traced values);
+* layer scale (γ per channel) as in the paper, init 1e-6.
+
+No BatchNorm anywhere → no cross-replica statistics problem (the issue
+the reference punted on, test/single_device.jl:51-58): every ConvNeXt
+config trains identically under data parallelism by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = [
+    "ConvNeXt",
+    "convnext_tiny",
+    "convnext_small",
+    "convnext_base",
+    "convnext_large",
+    "convnext_xlarge",
+    "convnext_test",
+]
+
+
+class ConvNeXtBlock(nn.Module):
+    """dwconv7×7 → LN → Dense(4d) → GELU → Dense(d) → layer-scale → droppath."""
+
+    dim: int
+    drop_path: float = 0.0
+    layer_scale_init: float = 1e-6
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        shortcut = x
+        x = nn.Conv(
+            self.dim, (7, 7), padding="SAME",
+            feature_group_count=self.dim,  # depthwise
+            dtype=self.dtype, name="dwconv",
+        )(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)
+        x = nn.Dense(4 * self.dim, dtype=self.dtype, name="pwconv1")(x)
+        x = nn.gelu(x)
+        x = nn.Dense(self.dim, dtype=self.dtype, name="pwconv2")(x)
+        gamma = self.param(
+            "layer_scale",
+            nn.initializers.constant(self.layer_scale_init),
+            (self.dim,), jnp.float32,
+        )
+        x = x * gamma.astype(self.dtype)
+        if self.drop_path > 0.0:
+            # stochastic depth: drop the whole residual branch per sample
+            x = nn.Dropout(
+                self.drop_path,
+                broadcast_dims=tuple(range(1, x.ndim)),
+                deterministic=not train,
+            )(x)
+        return shortcut + x
+
+
+class Downsample(nn.Module):
+    dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)
+        return nn.Conv(
+            self.dim, (2, 2), strides=(2, 2), dtype=self.dtype, name="conv"
+        )(x)
+
+
+class ConvNeXt(nn.Module):
+    """ConvNeXt classifier (stem 4×4/4, four stages, global-avg head)."""
+
+    depths: Sequence[int] = (3, 3, 9, 3)
+    dims: Sequence[int] = (96, 192, 384, 768)
+    num_classes: int = 1000
+    drop_path_rate: float = 0.0
+    layer_scale_init: float = 1e-6
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = jnp.asarray(x, self.dtype)
+        x = nn.Conv(
+            self.dims[0], (4, 4), strides=(4, 4), dtype=self.dtype, name="stem"
+        )(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="stem_norm")(x)
+        total = sum(self.depths)
+        block = 0
+        for stage, (depth, dim) in enumerate(zip(self.depths, self.dims)):
+            if stage > 0:
+                x = Downsample(dim, dtype=self.dtype, name=f"down{stage}")(x)
+            for _ in range(depth):
+                # linearly increasing drop-path rate, as in the paper
+                dp = self.drop_path_rate * block / max(total - 1, 1)
+                x = ConvNeXtBlock(
+                    dim, drop_path=dp, layer_scale_init=self.layer_scale_init,
+                    dtype=self.dtype, name=f"block{block}",
+                )(x, train=train)
+                block += 1
+        x = x.mean(axis=(1, 2))
+        x = nn.LayerNorm(dtype=jnp.float32, name="head_norm")(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def _convnext(kw: dict, **defaults) -> ConvNeXt:
+    for key, val in defaults.items():
+        kw.setdefault(key, val)
+    return ConvNeXt(**kw)
+
+
+def convnext_test(num_classes: int = 10, **kw) -> ConvNeXt:
+    """Tiny config for tests/dryruns (not a published variant)."""
+    return _convnext(kw, depths=(1, 1, 2, 1), dims=(16, 32, 64, 128),
+                     num_classes=num_classes)
+
+
+def convnext_tiny(num_classes: int = 1000, **kw) -> ConvNeXt:
+    return _convnext(kw, depths=(3, 3, 9, 3), dims=(96, 192, 384, 768),
+                     num_classes=num_classes)
+
+
+def convnext_small(num_classes: int = 1000, **kw) -> ConvNeXt:
+    return _convnext(kw, depths=(3, 3, 27, 3), dims=(96, 192, 384, 768),
+                     num_classes=num_classes)
+
+
+def convnext_base(num_classes: int = 1000, **kw) -> ConvNeXt:
+    return _convnext(kw, depths=(3, 3, 27, 3), dims=(128, 256, 512, 1024),
+                     num_classes=num_classes)
+
+
+def convnext_large(num_classes: int = 1000, **kw) -> ConvNeXt:
+    return _convnext(kw, depths=(3, 3, 27, 3), dims=(192, 384, 768, 1536),
+                     num_classes=num_classes)
+
+
+def convnext_xlarge(num_classes: int = 1000, **kw) -> ConvNeXt:
+    """The BASELINE 'ConvNeXt-XL large-batch (LARS)' config's model."""
+    return _convnext(kw, depths=(3, 3, 27, 3), dims=(256, 512, 1024, 2048),
+                     num_classes=num_classes)

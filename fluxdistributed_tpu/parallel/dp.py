@@ -73,24 +73,44 @@ class TrainState:
 
 
 # A loss function has signature
-#   loss_fn(params, model_state, batch, train: bool) -> (loss, (new_model_state, aux))
-# where ``batch`` is any pytree of arrays with a leading batch dim.
+#   loss_fn(params, model_state, batch, train: bool, rng=None)
+#       -> (loss, (new_model_state, aux))
+# where ``batch`` is any pytree of arrays with a leading batch dim and
+# ``rng`` (optional keyword) seeds stochastic layers (dropout/drop-path).
+# Four-argument custom loss functions remain supported — the step makers
+# only pass ``rng`` when the signature accepts it (``_accepts_rng``).
+
+
+def _accepts_rng(loss_fn: Callable) -> bool:
+    import inspect
+
+    try:
+        sig = inspect.signature(loss_fn)
+    except (TypeError, ValueError):
+        return False
+    p = sig.parameters.get("rng")
+    return p is not None or any(
+        q.kind is inspect.Parameter.VAR_KEYWORD for q in sig.parameters.values()
+    )
 
 
 def flax_loss_fn(model, loss, has_aux_state: bool = True) -> Callable:
     """Adapt a flax.linen module + a loss (e.g. ``logitcrossentropy``) to
     the framework's loss signature.  Handles mutable collections such as
-    ``batch_stats`` (BatchNorm running statistics)."""
+    ``batch_stats`` (BatchNorm running statistics) and stochastic layers
+    (``rng`` becomes the ``dropout`` stream, e.g. ViT dropout and
+    ConvNeXt stochastic depth)."""
 
-    def fn(params, model_state, batch, train: bool):
+    def fn(params, model_state, batch, train: bool, rng=None):
         x, y = batch["image"], batch["label"]
         variables = {"params": params, **model_state}
+        rngs = {"dropout": rng} if (train and rng is not None) else None
         if train and model_state:
             out, mutated = model.apply(
-                variables, x, train=True, mutable=list(model_state.keys())
+                variables, x, train=True, mutable=list(model_state.keys()), rngs=rngs
             )
             return loss(out, y), (mutated, out)
-        out = model.apply(variables, x, train=train)
+        out = model.apply(variables, x, train=train, rngs=rngs)
         return loss(out, y), (model_state, out)
 
     return fn
@@ -112,9 +132,15 @@ def make_train_step(
     """
     repl = NamedSharding(mesh, P())
     shard = NamedSharding(mesh, P(axis))
+    with_rng = _accepts_rng(loss_fn)
 
     def step(state: TrainState, batch):
         def lossf(params):
+            if with_rng:
+                # per-step dropout/drop-path stream, identical on every
+                # device (replicated state.step → replicated key)
+                rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
+                return loss_fn(params, state.model_state, batch, True, rng=rng)
             return loss_fn(params, state.model_state, batch, True)
 
         (loss, (new_mstate, _)), grads = jax.value_and_grad(lossf, has_aux=True)(
@@ -191,6 +217,7 @@ def make_train_step_shardmap(
     repl_spec = P()
     batch_spec = P(axis)
     nshards = mesh.shape[axis]
+    with_rng = _accepts_rng(loss_fn)
 
     @partial(
         jax.shard_map,
@@ -200,6 +227,14 @@ def make_train_step_shardmap(
     )
     def step(state: TrainState, batch):
         def lossf(params):
+            if with_rng:
+                # distinct stream per device so each batch shard draws
+                # independent dropout/drop-path masks
+                rng = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.PRNGKey(0), state.step),
+                    jax.lax.axis_index(axis),
+                )
+                return loss_fn(params, state.model_state, batch, True, rng=rng)
             return loss_fn(params, state.model_state, batch, True)
 
         (loss, (new_mstate, _)), grads = jax.value_and_grad(lossf, has_aux=True)(

@@ -1,6 +1,11 @@
 from .logging import ConsoleLogger, Logger, current_logger, with_logger
-from .trainer import TrainTask, prepare_training, train
+from .trainer import TrainTask, prepare_training, restore_training, train
 from .checkpoint import latest_step, load_checkpoint, save_checkpoint
+from .model_selection import (
+    SelectionTask,
+    prepare_model_selection,
+    train_model_selection,
+)
 
 __all__ = [
     "ConsoleLogger",
@@ -9,8 +14,12 @@ __all__ = [
     "with_logger",
     "TrainTask",
     "prepare_training",
+    "restore_training",
     "train",
     "save_checkpoint",
     "load_checkpoint",
     "latest_step",
+    "SelectionTask",
+    "prepare_model_selection",
+    "train_model_selection",
 ]

@@ -77,15 +77,18 @@ def latest_step(directory: str) -> Optional[int]:
 
 def load_checkpoint(
     directory: str,
-    target: Pytree,
+    target: Optional[Pytree] = None,
     step: Optional[int] = None,
     mesh=None,
 ) -> Pytree:
     """Restore a checkpoint onto the structure of ``target``.
 
-    ``step=None`` picks the latest (resume semantics).  With ``mesh``
-    given, restored arrays are placed replicated on the mesh, ready to
-    hand back to a compiled train step.
+    ``target=None`` restores the raw pytree as saved (nested dicts of
+    host arrays) with no structure requirements — useful when the saving
+    optimizer is unknown (e.g. inference tools that only need
+    ``restored["params"]``).  ``step=None`` picks the latest (resume
+    semantics).  With ``mesh`` given, restored arrays are placed
+    replicated on the mesh, ready to hand back to a compiled train step.
     """
     if step is None:
         step = latest_step(directory)
@@ -93,7 +96,21 @@ def load_checkpoint(
             raise FileNotFoundError(f"no checkpoints under {directory}")
     path = _step_dir(directory, step)
     ckptr = ocp.StandardCheckpointer()
-    restored = ckptr.restore(path, target=jax.tree.map(np.asarray, tree_lib.to_host(target)))
+    if target is None:
+        # Build a host-numpy target from the saved metadata instead of
+        # restoring blind: a blind restore re-applies the SAVED device
+        # shardings, which fails when the saving topology (e.g. 8 CPU
+        # devices) differs from the restoring one (e.g. 1 TPU).
+        meta = ckptr.metadata(path).item_metadata.tree
+        target = jax.tree.map(
+            lambda m: np.zeros(m.shape, m.dtype) if hasattr(m, "shape") else m,
+            meta,
+        )
+        restored = ckptr.restore(path, target=target)
+    else:
+        restored = ckptr.restore(
+            path, target=jax.tree.map(np.asarray, tree_lib.to_host(target))
+        )
     if mesh is not None:
         from ..sharding import replicate
 

@@ -1,0 +1,200 @@
+"""Model-selection "parallelism" — the reference's legacy per-worker trainer.
+
+Re-implements component #13 (SURVEY §2): ``run_distributed`` in
+src/test.jl trains one independent replica per worker (``distribute``
+:26-41, no gradient averaging), and after each cycle picks the replica
+with the LOWEST validation loss as the next round's model for everyone
+(:58) — model selection instead of grad sync — dividing the LR by 5
+every 10 cycles (:50).
+
+TPU-native design: replicas live as ONE stacked pytree with a leading
+replica axis sharded over the mesh's data axis, so "N independent
+trainers" is a single ``vmap``-ed compiled step — no tasks, no worker
+processes.  Selection (eval → argmin → broadcast-best) is also compiled:
+``jnp.take`` along the replica axis followed by re-broadcast, which XLA
+lowers to one all-gather-style collective.  The dead reference path
+becomes a live, tested feature.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import mesh as mesh_lib
+from ..ops import logitcrossentropy, onehot
+from ..optim import Optimizer
+from ..parallel.dp import flax_loss_fn
+from .logging import Logger, current_logger
+
+Pytree = Any
+
+__all__ = ["SelectionTask", "prepare_model_selection", "train_model_selection"]
+
+
+@dataclasses.dataclass
+class SelectionTask:
+    params: Pytree  # stacked (R, ...) leaves, sharded on the replica axis
+    opt_state: Pytree
+    model_state: Pytree
+    step_fn: Callable
+    select_fn: Callable
+    mesh: Mesh
+    model: Any
+    replicas: int
+
+
+def _stack(tree: Pytree, r: int) -> Pytree:
+    return jax.tree.map(lambda x: jnp.broadcast_to(x[None], (r, *x.shape)), tree)
+
+
+def prepare_model_selection(
+    model,
+    optimizer: Optimizer,
+    *,
+    mesh: Optional[Mesh] = None,
+    replicas: Optional[int] = None,
+    loss: Callable = logitcrossentropy,
+    input_shape=(32, 32, 3),
+    seed: int = 0,
+) -> SelectionTask:
+    """Stack R independently-trained replicas and compile the two steps.
+
+    Unlike the reference (identical init broadcast from process 1,
+    src/test.jl:28), each replica gets its OWN init key — the ensemble
+    explores different basins, which is the point of selection training.
+    """
+    mesh = mesh or mesh_lib.data_mesh()
+    axis = mesh_lib.DATA_AXIS
+    r = replicas or mesh.shape[axis]
+    if r % mesh.shape[axis] != 0:
+        raise ValueError(f"replicas ({r}) must divide over mesh axis {mesh.shape[axis]}")
+
+    dummy = np.zeros((1, *input_shape), np.float32)
+    keys = jax.random.split(jax.random.PRNGKey(seed), r)
+
+    def init_one(key):
+        p_rng, d_rng = jax.random.split(key)
+        variables = model.init({"params": p_rng, "dropout": d_rng}, dummy, train=True)
+        params = variables["params"]
+        mstate = {k: v for k, v in variables.items() if k != "params"}
+        return params, optimizer.init(params), mstate
+
+    params, opt_state, model_state = jax.vmap(init_one)(keys)
+    rep = NamedSharding(mesh, P(axis))  # replica-axis sharding
+    params, opt_state, model_state = jax.device_put((params, opt_state, model_state), rep)
+
+    loss_fn = flax_loss_fn(model, loss)
+
+    def one_step(params, opt_state, mstate, batch, step):
+        def lossf(p):
+            rng = jax.random.fold_in(jax.random.PRNGKey(1), step)
+            return loss_fn(p, mstate, batch, True, rng=rng)
+
+        (l, (new_mstate, _)), grads = jax.value_and_grad(lossf, has_aux=True)(params)
+        new_params, new_opt = optimizer.apply(params, grads, opt_state, step)
+        return new_params, new_opt, new_mstate, l
+
+    # vmap over the stacked replica axis: R independent training steps in
+    # one compiled program (the ``asyncmap`` over workers, src/test.jl:33).
+    vstep = jax.vmap(one_step, in_axes=(0, 0, 0, 0, None))
+    step_fn = jax.jit(vstep)
+
+    def select(params, opt_state, mstate, val_batch):
+        def eval_one(p, ms):
+            l, _ = loss_fn(p, ms, val_batch, False)
+            return l
+
+        losses = jax.vmap(eval_one)(params, mstate)
+        best = jnp.argmin(losses)  # min-val-loss replica, src/test.jl:58
+
+        def bcast(x):
+            return jnp.broadcast_to(x[best][None], x.shape)
+
+        return (
+            jax.tree.map(bcast, params),
+            jax.tree.map(bcast, opt_state),
+            jax.tree.map(bcast, mstate),
+            losses,
+        )
+
+    select_fn = jax.jit(select)
+
+    return SelectionTask(
+        params=params,
+        opt_state=opt_state,
+        model_state=model_state,
+        step_fn=step_fn,
+        select_fn=select_fn,
+        mesh=mesh,
+        model=model,
+        replicas=r,
+    )
+
+
+def train_model_selection(
+    task: SelectionTask,
+    dataset,
+    val_batch: dict,
+    *,
+    cycles: int = 10,
+    steps_per_cycle: int = 1,
+    batch_size_per_replica: int = 8,
+    seed: int = 0,
+    logger: Optional[Logger] = None,
+):
+    """Run the select-the-best loop (``run_distributed`` src/test.jl:43-63).
+
+    Each cycle: every replica trains ``steps_per_cycle`` steps on its own
+    random sample (the per-worker ``tmp`` loop :13-24), then the
+    min-val-loss replica is broadcast to all (:58).  LR scheduling is the
+    optimizer's business — pass ``optim.step_decay(lr0, 0.2, every=10)``
+    to reproduce the reference's LR/5-every-10 (:50).
+
+    Returns host copies of the (identical) selected replica's params and
+    the per-cycle selection-loss history.
+    """
+    logger = logger or current_logger()
+    rng = np.random.default_rng(seed)
+    r = task.replicas
+    history = []
+    step = jnp.zeros((), jnp.int32)
+    for cycle in range(cycles):
+        for _ in range(steps_per_cycle):
+            imgs, labels = dataset.batch(rng, r * batch_size_per_replica)
+            batch = {
+                "image": jnp.asarray(imgs).reshape(r, batch_size_per_replica, *imgs.shape[1:]),
+                "label": onehot(
+                    jnp.asarray(labels).reshape(r, batch_size_per_replica),
+                    dataset.nclasses,
+                ),
+            }
+            batch = jax.device_put(
+                batch, NamedSharding(task.mesh, P(mesh_lib.DATA_AXIS))
+            )
+            task.params, task.opt_state, task.model_state, train_losses = task.step_fn(
+                task.params, task.opt_state, task.model_state, batch, step
+            )
+            step = step + 1
+        task.params, task.opt_state, task.model_state, val_losses = task.select_fn(
+            task.params, task.opt_state, task.model_state, val_batch
+        )
+        val_losses = np.asarray(val_losses)
+        history.append(val_losses)
+        logger.log(
+            {
+                "selection_best_loss": float(val_losses.min()),
+                "selection_best_replica": int(val_losses.argmin()),
+                "selection_mean_loss": float(val_losses.mean()),
+            },
+            cycle,
+        )
+    from .. import tree as tree_lib
+
+    best_params = jax.tree.map(lambda x: x[0], task.params)
+    return tree_lib.to_host(best_params), history

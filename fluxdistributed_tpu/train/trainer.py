@@ -105,9 +105,11 @@ def prepare_training(
         imgs, _ = dataset.batch(np.random.default_rng(0), 1)
         input_shape = imgs.shape[1:]
 
-    rng = jax.random.PRNGKey(seed)
+    p_rng, d_rng = jax.random.split(jax.random.PRNGKey(seed))
     dummy = np.zeros((1, *input_shape), np.float32)
-    variables = model.init(rng, dummy, train=True)
+    # 'dropout' stream present at init so stochastic models (ViT dropout,
+    # ConvNeXt drop-path) initialize under train=True
+    variables = model.init({"params": p_rng, "dropout": d_rng}, dummy, train=True)
     params = variables["params"]
     model_state = {k: v for k, v in variables.items() if k != "params"}  # e.g. batch_stats
 
@@ -156,6 +158,23 @@ def prepare_training(
     )
 
 
+def restore_training(
+    task: TrainTask, checkpoint_dir: str, step: Optional[int] = None
+) -> TrainTask:
+    """Resume a prepared task from a checkpoint — the path the reference
+    lacks entirely (SURVEY §5: "no resume"; its checkpoints hold model
+    weights only, src/sync.jl:156-161, while ours carry params +
+    optimizer state + BatchNorm stats + step counter).
+
+    Restores the latest (or given) step from ``checkpoint_dir`` onto the
+    task's mesh, replicated, ready for ``train``.
+    """
+    from .checkpoint import load_checkpoint
+
+    task.state = load_checkpoint(checkpoint_dir, task.state, step=step, mesh=task.mesh)
+    return task
+
+
 def _is_oom(err: Exception) -> bool:
     s = str(err)
     return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or "OOM" in s
@@ -189,6 +208,9 @@ def train(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 20,
     verbose: bool = False,
+    profile_dir: Optional[str] = None,
+    profile_start: int = 10,
+    profile_steps: int = 5,
 ):
     """The training loop (``train`` src/ddp_tasks.jl:174-247).
 
@@ -197,15 +219,46 @@ def train(
     checkpoint every ``checkpoint_every`` cycles (ref 20, src/sync.jl:156),
     OOM-skip with a live ``num_missed`` counter (ref :230-238).
 
+    Beyond the reference (whose only timing hook is dead code, SURVEY §5):
+    steps/sec + images/sec are logged at every ``print_every`` cadence,
+    and ``profile_dir`` captures a ``jax.profiler`` device trace of steps
+    ``[profile_start, profile_start + profile_steps)`` for TensorBoard.
+
     Returns ``(host_params, host_model_state, task)`` — the host-side
     model copy the reference returns from ``train`` (:241-246).
     """
     logger = logger or current_logger()
     t_start = time.time()
+    t_mark, j_mark = t_start, 0
+    profiling = False
 
     for j, batch in enumerate(task.loader):
         if print_every and j % print_every == 0:
-            logger.info(f"cycle {j} (t={time.time() - t_start:.1f}s)")
+            now = time.time()
+            if j > j_mark:
+                # interval rates; the loop can only run ahead of the device
+                # by the dispatch queue, so interval averages are accurate
+                dsteps = j - j_mark
+                dt = max(now - t_mark, 1e-9)
+                gbatch = int(jax.tree.leaves(batch)[0].shape[0])
+                logger.log(
+                    {
+                        "steps_per_sec": round(dsteps / dt, 3),
+                        "images_per_sec": round(dsteps * gbatch / dt, 1),
+                    },
+                    j,
+                )
+                t_mark, j_mark = now, j
+            logger.info(f"cycle {j} (t={now - t_start:.1f}s)")
+        if profile_dir is not None:
+            if j == profile_start:
+                jax.profiler.start_trace(profile_dir)
+                profiling = True
+            elif profiling and j == profile_start + profile_steps:
+                tree_lib.synchronize(task.state.params)
+                jax.profiler.stop_trace()
+                profiling = False
+                logger.info(f"profiler trace written to {profile_dir}")
         if sched is not None:
             lr = sched(j)
             if verbose and lr is not None:
@@ -249,6 +302,10 @@ def train(
 
             save_checkpoint(task.state, checkpoint_dir, int(task.state.step))
 
+    if profiling:
+        tree_lib.synchronize(task.state.params)
+        jax.profiler.stop_trace()
+        logger.info(f"profiler trace written to {profile_dir}")
     if task.num_missed:
         logger.info(f"missed {task.num_missed} batches due to OOM")
     host_params = tree_lib.to_host(task.state.params)
