@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Inference demo CLI — classify images with a trained checkpoint.
+
+TPU-native replacement for the reference's Pluto inference notebook
+(bin/pluto.jl): where the notebook fetches a trained BSON model from
+JuliaHub job results (:52-124), captures a webcam frame via embedded
+HTML/JS (:133-334) and prints the top-3 ImageNet labels (:338-382), this
+CLI loads an orbax checkpoint produced by the trainer, preprocesses
+images through the same native/PIL pipeline training uses, runs one
+jitted forward pass, and prints the ``showpreds`` top-k table
+(src/utils.jl:47-71 analog).
+
+    python bin/infer.py --model resnet50 --checkpoint ckpts/ \
+        --synset LOC_synset_mapping.txt cat.jpg dog.jpg
+
+    # no checkpoint/images → random-init demo on a synthetic image
+    python bin/infer.py --model resnet18 --num-classes 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("images", nargs="*", help="image files (JPEG/PNG)")
+    p.add_argument("--model", default="resnet50",
+                   help="model factory name in fluxdistributed_tpu.models")
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--checkpoint", default=None,
+                   help="checkpoint dir from the trainer (latest step used; "
+                        "random init if omitted)")
+    p.add_argument("--step", type=int, default=None, help="specific checkpoint step")
+    p.add_argument("--synset", default=None,
+                   help="LOC_synset_mapping.txt for human-readable labels")
+    p.add_argument("--topk", type=int, default=3,
+                   help="predictions per image (reference demo: top-3)")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--resize", type=int, default=256)
+    p.add_argument("--platform", default=None,
+                   help="force a JAX platform (e.g. 'cpu'); needed where "
+                        "site hooks import jax before JAX_PLATFORMS applies")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import numpy as np
+
+    from fluxdistributed_tpu import models as models_lib
+    from fluxdistributed_tpu.data.preprocess import preprocess
+    from fluxdistributed_tpu.ops import showpreds
+
+    factory = getattr(models_lib, args.model, None)
+    if factory is None:
+        print(f"unknown model {args.model!r}", file=sys.stderr)
+        return 2
+    model = factory(num_classes=args.num_classes)
+
+    names = None
+    if args.synset:
+        from fluxdistributed_tpu.data.imagenet import labels
+
+        table = labels(args.synset)
+        names = [n.split(",")[0] for n in table.names]
+
+    if args.images:
+        batch = np.stack(
+            [preprocess(p, crop=args.image_size, resize=args.resize) for p in args.images]
+        )
+        row_names = args.images
+    else:
+        print("(no images given — running a random-init demo on noise)")
+        batch = np.random.default_rng(0).normal(
+            0, 1, (1, args.image_size, args.image_size, 3)
+        ).astype(np.float32)
+        row_names = ["<synthetic>"]
+
+    variables = model.init(jax.random.PRNGKey(0), batch[:1], train=False)
+    if args.checkpoint:
+        from fluxdistributed_tpu.train.checkpoint import load_checkpoint
+
+        # raw (target-free) restore: works for checkpoints from ANY
+        # optimizer — inference only needs params/model_state/step
+        restored = load_checkpoint(args.checkpoint, step=args.step)
+        variables = {"params": restored["params"], **restored.get("model_state", {})}
+        print(f"restored checkpoint step {int(restored['step'])} from {args.checkpoint}")
+
+    @jax.jit
+    def forward(variables, x):
+        return model.apply(variables, x, train=False)
+
+    logits = np.asarray(forward(variables, batch))
+    print(showpreds(logits, class_names=names, k=args.topk, names=row_names))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

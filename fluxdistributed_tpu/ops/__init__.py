@@ -1,5 +1,5 @@
 from .losses import logitcrossentropy, crossentropy, mse
-from .metrics import topkaccuracy, onehot
+from .metrics import topkaccuracy, onehot, showpreds
 from .attention import dot_product_attention, blockwise_attention
 
 __all__ = [
@@ -8,6 +8,7 @@ __all__ = [
     "mse",
     "topkaccuracy",
     "onehot",
+    "showpreds",
     "dot_product_attention",
     "blockwise_attention",
 ]

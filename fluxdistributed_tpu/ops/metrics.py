@@ -12,7 +12,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["topkaccuracy", "onehot"]
+__all__ = ["topkaccuracy", "onehot", "showpreds"]
 
 
 def onehot(labels, nclasses: int):
@@ -35,3 +35,30 @@ def topkaccuracy(scores, labels, k: int = 5):
     _, topk_idx = jax.lax.top_k(scores, k)
     hits = jnp.any(topk_idx == labels[:, None], axis=-1)
     return jnp.mean(hits.astype(jnp.float32))
+
+
+def showpreds(logits, class_names=None, k: int = 3, names=None) -> str:
+    """Pretty-print the top-k predictions per sample — the ``showpreds``
+    table analog (src/utils.jl:47-71, used by the reference's Pluto
+    webcam demo bin/pluto.jl:338-382).
+
+    ``logits``: (batch, classes) host array; ``class_names``: optional
+    list mapping class index → human-readable label; ``names``: optional
+    per-sample row labels (e.g. file names).  Returns the formatted table
+    (also suitable for ``print``).
+    """
+    import numpy as np
+
+    logits = np.asarray(logits)
+    probs = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+    probs = np.asarray(probs)
+    k = min(k, logits.shape[-1])
+    lines = []
+    for i in range(logits.shape[0]):
+        order = np.argsort(-probs[i])[:k]
+        row = names[i] if names is not None else f"sample {i}"
+        lines.append(f"{row}:")
+        for rank, c in enumerate(order, 1):
+            label = class_names[c] if class_names is not None else f"class {c}"
+            lines.append(f"  {rank}. {label:<40s} {probs[i, c]:7.4f}")
+    return "\n".join(lines)
