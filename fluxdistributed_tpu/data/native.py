@@ -205,6 +205,8 @@ def load_batch(
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
+    if not 1 <= crop <= resize:
+        raise ValueError(f"need 1 <= crop <= resize, got crop={crop} resize={resize}")
     n = len(paths)
     if out is None:
         out = np.empty((n, crop, crop, 3), np.float32)

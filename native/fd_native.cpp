@@ -309,6 +309,14 @@ int fd_load_batch(const char** paths, int n, int resize, int crop,
                   float* out, int nthreads, char* errbuf, int errlen,
                   unsigned char* failed) {
   if (n <= 0) return 0;
+  if (!out || crop < 1 || resize < 1 || crop > resize) {
+    if (errbuf && errlen > 0)
+      std::snprintf(errbuf, size_t(errlen),
+                    "invalid crop/resize (%d/%d): need 1 <= crop <= resize",
+                    crop, resize);
+    if (failed) std::memset(failed, 1, size_t(n));
+    return n;
+  }
   nthreads = std::max(1, std::min(nthreads, n));
   std::atomic<int> next(0), failures(0);
   std::atomic<bool> have_err(false);

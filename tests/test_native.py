@@ -113,6 +113,11 @@ def test_load_batch_fallback_handles_png_disguised_as_jpeg(jpeg_dir, tmp_path, i
     np.testing.assert_allclose(out[1], ref, atol=1e-5)
 
 
+def test_load_batch_rejects_crop_larger_than_resize(jpeg_dir):
+    with pytest.raises(ValueError, match="crop <= resize"):
+        native.load_batch(jpeg_dir, crop=288, resize=256)
+
+
 def test_load_batch_rejects_noncontiguous_out(jpeg_dir):
     big = np.empty((len(jpeg_dir), 224, 224, 6), np.float32)
     view = big[..., ::2]  # right shape/dtype, wrong strides
