@@ -7,6 +7,8 @@ from .context import (
     ulysses_attention,
 )
 from .dp import TrainState, make_train_step, make_eval_step, make_train_step_shardmap
+from .ep import moe_apply, router_dispatch, stack_expert_params
+from .pp import make_train_step_pp, pipeline_apply, stack_stage_params
 from .tp import make_train_step_tp, param_specs, shard_state, vit_tp_rules
 
 __all__ = [
@@ -28,4 +30,10 @@ __all__ = [
     "param_specs",
     "shard_state",
     "vit_tp_rules",
+    "pipeline_apply",
+    "make_train_step_pp",
+    "stack_stage_params",
+    "moe_apply",
+    "router_dispatch",
+    "stack_expert_params",
 ]

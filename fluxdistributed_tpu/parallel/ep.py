@@ -1,0 +1,125 @@
+"""Expert parallelism (MoE): switch-style top-1 routing with capacity,
+experts sharded one-per-device over an ``expert`` mesh axis.
+
+Net-new scope beyond the reference (SURVEY §2: "EP: NO"), built the
+TPU-classic way (Mesh-TF/Switch lineage): tokens are sharded over the
+same ``expert`` axis, routing/dispatch build ``(tokens, experts,
+capacity)`` one-hots locally, and two ``all_to_all`` collectives move
+token activations to their expert's device and back — dense einsums and
+static shapes throughout, so XLA keeps everything on the MXU (no
+gather/scatter in the hot path).
+
+Semantics (Switch Transformer):
+* top-1 expert per token, output scaled by the router probability;
+* per-shard expert capacity ``C = ceil(tokens_per_shard / E *
+  capacity_factor)``; tokens over capacity are DROPPED (output zero) —
+  the documented switch behavior;
+* auxiliary load-balance loss ``E * Σ_e f_e · p_e`` (fraction routed ×
+  mean router prob), returned for the caller to add to the task loss.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Pytree = Any
+
+__all__ = ["moe_apply", "router_dispatch", "stack_expert_params"]
+
+EXPERT_AXIS = "expert"
+
+
+def stack_expert_params(per_expert: list, mesh: Mesh, axis: str = EXPERT_AXIS) -> Pytree:
+    """Stack E per-expert param trees on a leading dim sharded over
+    ``axis`` — expert e's params live on expert-device e."""
+    from ..sharding import stack_on_axis
+
+    return stack_on_axis(per_expert, mesh, axis)
+
+
+def router_dispatch(logits: jnp.ndarray, capacity: int):
+    """Top-1 dispatch/combine tensors from router logits.
+
+    ``logits``: (T, E).  Returns ``dispatch`` (T, E, C) {0,1},
+    ``combine`` (T, E, C) = dispatch · router prob, and the switch
+    load-balance auxiliary loss.  Pure jnp — used identically inside the
+    sharded program and by the single-device golden model in tests.
+    """
+    t, e = logits.shape
+    dtype = logits.dtype
+    # routing math in f32 regardless of compute dtype: a bf16 cumsum
+    # saturates at 256, collapsing every later queue position onto slot
+    # 255 (silent dispatch corruption for large expert queues)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # (T,)
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (T, E)
+    # position of each token in its expert's queue (0-based)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0
+    kept = (pos >= 0) & (pos < capacity)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+    dispatch = (pos_oh * kept.astype(jnp.float32)[..., None]).astype(dtype)
+    gate = jnp.max(probs * onehot, axis=-1)  # (T,) routed prob, f32
+    combine = (dispatch.astype(jnp.float32) * gate[:, None, None]).astype(dtype)
+    # load-balance aux: E * Σ_e (fraction of tokens to e) · (mean prob of e)
+    frac = onehot.mean(axis=0)
+    mean_p = probs.mean(axis=0)
+    aux = e * jnp.sum(frac * mean_p)
+    return dispatch, combine, aux
+
+
+def moe_apply(
+    expert_fn: Callable,
+    mesh: Mesh,
+    axis: str = EXPERT_AXIS,
+    capacity_factor: float = 1.25,
+    capacity: Optional[int] = None,
+):
+    """Build ``fn(stacked_params, router_w, x) -> (y, aux)``.
+
+    ``x``: (T, D) tokens sharded on ``axis``; ``router_w``: (D, E)
+    replicated; ``stacked_params`` leaves (E, ...) sharded on ``axis``.
+    E must equal the ``axis`` size (one expert per device).  Output is
+    token-sharded like ``x``; ``aux`` is the replicated (pmean-ed)
+    load-balance loss.
+    """
+    e_devices = mesh.shape[axis]
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P(axis)),
+        out_specs=(P(axis), P()),
+    )
+    def run(stacked_params, router_w, x):
+        params = jax.tree.map(lambda p: p[0], stacked_params)  # my expert
+        t, d = x.shape
+        e = router_w.shape[-1]
+        assert e == e_devices, f"experts ({e}) must equal '{axis}' size ({e_devices})"
+        if capacity is not None:
+            if capacity < 1:
+                raise ValueError(f"capacity must be >= 1, got {capacity}")
+            cap = capacity
+        else:
+            cap = max(1, math.ceil(t / e * capacity_factor))
+        logits = x @ router_w
+        dispatch, combine, aux = router_dispatch(logits, cap)
+        # (T,D),(T,E,C) → (E,C,D): each expert's queue from this shard
+        expert_in = jnp.einsum("td,tec->ecd", x, dispatch)
+        # exchange: device e receives every shard's queue for expert e
+        expert_in = jax.lax.all_to_all(
+            expert_in, axis, split_axis=0, concat_axis=0, tiled=False
+        )  # (S, C, D) with S = number of shards
+        s = expert_in.shape[0]
+        y = expert_fn(params, expert_in.reshape(s * cap, d)).reshape(s, cap, d)
+        # route results back to the token-owning shards
+        y = jax.lax.all_to_all(y, axis, split_axis=0, concat_axis=0, tiled=False)
+        out = jnp.einsum("ecd,tec->td", y, combine)
+        return out, jax.lax.pmean(aux, axis)
+
+    return run

@@ -34,6 +34,8 @@ __all__ = [
     "shard_batch",
     "partition_by_rules",
     "make_shardings",
+    "ensure_synced",
+    "stack_on_axis",
 ]
 
 
@@ -67,6 +69,57 @@ def replicate(tree: Pytree, mesh: Mesh) -> Pytree:
     """
     s = replicated(mesh)
     return jax.tree.map(lambda x: jax.device_put(unaliased(x), s), tree)
+
+
+def ensure_synced(tree: Pytree, rtol: float = 0.0, atol: float = 0.0) -> bool:
+    """Verify that every device's copy of each replicated leaf is
+    identical — the reference's ``ensure_synced`` debug check
+    (src/ddp_tasks.jl:115-126, used by its replica-identity tests
+    test/single_device.jl:160-167).
+
+    Under ``NamedSharding(P())`` XLA maintains this by construction; the
+    check exists for debugging custom sharding code and for tests.  Pulls
+    every shard to host — debug/test use only.  Raises AssertionError
+    with the offending leaf path on mismatch; returns True otherwise.
+    """
+    import numpy as np
+
+    from jax.tree_util import tree_flatten_with_path, keystr
+
+    leaves, _ = tree_flatten_with_path(tree)
+    for path, leaf in leaves:
+        if not isinstance(leaf, jax.Array) or not hasattr(leaf, "addressable_shards"):
+            continue
+        shards = leaf.addressable_shards
+        if len(shards) < 2:
+            continue
+        # only fully-replicated leaves have whole-array shards everywhere
+        if shards[0].data.shape != leaf.shape:
+            continue
+        ref = np.asarray(shards[0].data)
+        for s in shards[1:]:
+            got = np.asarray(s.data)
+            # equal_nan: identical NaNs (a diverged-but-synced run) are
+            # NOT replica divergence — this check is about sharding bugs
+            if not np.allclose(ref, got, rtol=rtol, atol=atol, equal_nan=True):
+                raise AssertionError(
+                    f"replica divergence at {keystr(path)}: device "
+                    f"{shards[0].device} vs {s.device}, max abs err "
+                    f"{np.abs(ref - got).max()}"
+                )
+    return True
+
+
+def stack_on_axis(per_item: Sequence[Pytree], mesh: Mesh, axis: str) -> Pytree:
+    """Stack N per-item param trees on a new leading dim sharded over
+    ``axis`` — item i's tree lives on device i of the axis.  Shared
+    machinery for pipeline stages (``pp.stack_stage_params``) and MoE
+    experts (``ep.stack_expert_params``)."""
+    import jax.numpy as jnp
+
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_item)
+    sh = NamedSharding(mesh, P(axis))
+    return jax.tree.map(lambda x: jax.device_put(x, sh), stacked)
 
 
 def shard_batch(batch: Pytree, mesh: Mesh, axis: str = mesh_lib.DATA_AXIS) -> Pytree:

@@ -1,0 +1,111 @@
+"""Pipeline parallelism: GPipe schedule == sequential stage application.
+
+Invariants: the pipelined forward matches applying the S stages in
+sequence on one device; gradients through the pipeline match sequential
+gradients; the compiled PP train step trains (loss falls) with
+stage-sharded params and optimizer state.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fluxdistributed_tpu import mesh as mesh_lib, optim
+from fluxdistributed_tpu.ops import logitcrossentropy, onehot
+from fluxdistributed_tpu.parallel.dp import TrainState
+from fluxdistributed_tpu.parallel.pp import (
+    make_train_step_pp,
+    pipeline_apply,
+    stack_stage_params,
+)
+
+S = 4  # stages
+D = 16  # residual width
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return mesh_lib.make_mesh({"pipe": S})
+
+
+def stage_fn(params, x):
+    """One homogeneous stage: residual Dense+gelu (same in/out shape)."""
+    return x + jax.nn.gelu(x @ params["w"] + params["b"])
+
+
+def _stage_params(key):
+    kw, _ = jax.random.split(key)
+    return {
+        "w": jax.random.normal(kw, (D, D), jnp.float32) * 0.3,
+        "b": jnp.zeros((D,), jnp.float32),
+    }
+
+
+def _sequential(per_stage, x):
+    for p in per_stage:
+        x = stage_fn(p, x)
+    return x
+
+
+@pytest.fixture(scope="module")
+def per_stage():
+    keys = jax.random.split(jax.random.PRNGKey(0), S)
+    return [_stage_params(k) for k in keys]
+
+
+def test_pipeline_matches_sequential_forward(mesh, per_stage):
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, D), jnp.float32)
+    stacked = stack_stage_params(per_stage, mesh)
+    for m in (2, 4, 8):  # microbatch counts, incl. M != S
+        fwd = pipeline_apply(stage_fn, mesh, num_microbatches=m)
+        got = np.asarray(fwd(stacked, x))
+        want = np.asarray(_sequential(per_stage, x))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_gradients_match_sequential(mesh, per_stage):
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, D), jnp.float32)
+    stacked = stack_stage_params(per_stage, mesh)
+    fwd = pipeline_apply(stage_fn, mesh, num_microbatches=4)
+
+    def loss_pp(params):
+        return jnp.mean(fwd(params, x) ** 2)
+
+    def loss_seq(stages):
+        return jnp.mean(_sequential(stages, x) ** 2)
+
+    g_pp = jax.grad(loss_pp)(stacked)
+    g_seq = jax.grad(loss_seq)(per_stage)
+    g_seq_stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *g_seq)
+    for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_seq_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_pp_train_step_loss_falls(mesh):
+    """Stage-sharded end-to-end training: readout folded into the loss,
+    stages trained through the compiled pipelined step."""
+    nclasses = D  # use the residual stream's last layer as logits
+    rng = np.random.default_rng(0)
+    n = 32
+    y = rng.integers(0, 2, n)  # 2 distinguishable classes
+    x = rng.normal(0, 0.3, (n, D)).astype(np.float32)
+    x[:, 0] += y * 2.0  # separable signal in feature 0
+    labels = np.asarray(onehot(y, nclasses))
+
+    keys = jax.random.split(jax.random.PRNGKey(3), S)
+    per_stage = [_stage_params(k) for k in keys]
+    stacked = stack_stage_params(per_stage, mesh)
+    opt = optim.momentum(0.1, 0.9)
+    state = TrainState.create(stacked, opt)
+    compile_for = make_train_step_pp(
+        stage_fn, logitcrossentropy, opt, mesh, num_microbatches=4, donate=False
+    )
+    step = compile_for(state)
+    batch = {"image": jnp.asarray(x), "label": jnp.asarray(labels)}
+    losses = []
+    for _ in range(25):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.6, losses[::8]
+    assert int(state.step) == 25
