@@ -42,7 +42,7 @@ def lib_path() -> str:
     return _SO
 
 
-_ABI_VERSION = 2  # must match fd_version() in fd_native.cpp
+_ABI_VERSION = 3  # must match fd_version() in fd_native.cpp
 
 
 def _build() -> bool:
@@ -98,6 +98,7 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
         ]
         lib.fd_decode_jpeg_file.restype = ctypes.c_int
         lib.fd_decode_jpeg_file.argtypes = [
@@ -111,7 +112,7 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.c_int, ctypes.POINTER(ctypes.c_float),
             ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
         ]
         lib.fd_free.argtypes = [ctypes.c_void_p]
         _lib = lib

@@ -31,6 +31,8 @@ __all__ = [
     "resize_smallest_dimension",
     "center_crop",
     "preprocess",
+    "sample_augment_params",
+    "random_resized_crop",
 ]
 
 # Reference constants, src/preprocess.jl:51-53
@@ -80,6 +82,57 @@ def center_crop(img: np.ndarray, size: int = 224) -> np.ndarray:
     return img[top : top + size, left : left + size]
 
 
+def sample_augment_params(rng: np.random.Generator, n: int) -> np.ndarray:
+    """Sample (n, 5) train-augmentation parameters: ``(area_frac,
+    log_ratio→ratio, u, v, flip)`` — the torchvision RandomResizedCrop
+    distribution (scale 0.08–1.0, aspect 3/4–4/3) + p=0.5 hflip.
+
+    Parameters are RELATIVE so they can be sampled before image
+    dimensions are known; the executor (Python or native C++) converts
+    them to a pixel rect after decode.  Keeping the RNG in Python keeps
+    the native pipeline deterministic and both paths reproducible from
+    the same draw.
+    """
+    area = rng.uniform(0.08, 1.0, n)
+    ratio = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3), n))
+    u = rng.uniform(0, 1, n)
+    v = rng.uniform(0, 1, n)
+    flip = (rng.uniform(0, 1, n) < 0.5).astype(np.float64)
+    return np.stack([area, ratio, u, v, flip], axis=1).astype(np.float32)
+
+
+def _aug_rect(h: int, w: int, area: float, ratio: float, u: float, v: float):
+    """Pixel crop rect from relative params (shared contract with the
+    native implementation — keep in sync with fd_native.cpp aug_rect)."""
+    target = area * h * w
+    cw = int(round(np.sqrt(target * ratio)))
+    ch = int(round(np.sqrt(target / ratio)))
+    if cw < 1 or ch < 1 or cw > w or ch > h:
+        # fallback: largest centered square (torchvision's fallback is
+        # a center crop of the min side)
+        side = min(h, w)
+        return (h - side) // 2, (w - side) // 2, side, side
+    y0 = int(round(v * (h - ch)))
+    x0 = int(round(u * (w - cw)))
+    return y0, x0, ch, cw
+
+
+def random_resized_crop(img: np.ndarray, crop: int, params) -> np.ndarray:
+    """Apply one ``sample_augment_params`` row: crop the sampled rect,
+    resize to ``crop``×``crop``, horizontal-flip if flagged."""
+    from PIL import Image
+
+    area, ratio, u, v, flip = (float(p) for p in params)
+    h, w = img.shape[:2]
+    y0, x0, ch, cw = _aug_rect(h, w, area, ratio, u, v)
+    region = img[y0 : y0 + ch, x0 : x0 + cw]
+    pil = Image.fromarray(region).resize((crop, crop), Image.BILINEAR, reducing_gap=2.0)
+    out = np.asarray(pil, np.uint8)
+    if flip >= 0.5:
+        out = out[:, ::-1]
+    return out
+
+
 def preprocess(
     img,
     crop: int = 224,
@@ -87,8 +140,13 @@ def preprocess(
     mean: Sequence[float] = IMAGENET_MEAN,
     std: Sequence[float] = IMAGENET_STD,
     compat_double_normalize: bool = False,
+    augment=None,
 ) -> np.ndarray:
     """Full pipeline: decode (if needed) → resize → crop → normalize.
+
+    ``augment``: an optional ``sample_augment_params`` row switching the
+    geometric stage to RandomResizedCrop+flip (train mode); the default
+    is the eval/reference path (resize smallest side → center crop).
 
     Returns HWC float32 (NHWC once batched) — the TPU-native layout; the
     reference's WHCN permute (src/preprocess.jl:64-65) is a Julia
@@ -96,8 +154,11 @@ def preprocess(
     """
     if not isinstance(img, np.ndarray):
         img = decode_image(img)
-    img = resize_smallest_dimension(img, resize)
-    img = center_crop(img, crop)
+    if augment is not None:
+        img = random_resized_crop(img, crop, augment)
+    else:
+        img = resize_smallest_dimension(img, resize)
+        img = center_crop(img, crop)
     x = img.astype(np.float32) / 255.0
     x = (x - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
     if compat_double_normalize:

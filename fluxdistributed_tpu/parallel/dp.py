@@ -122,6 +122,7 @@ def make_train_step(
     mesh: Mesh,
     axis: str = mesh_lib.DATA_AXIS,
     donate: bool = True,
+    accum_steps: int = 1,
 ):
     """Compile the full DP training step under ``jit`` + shardings.
 
@@ -129,23 +130,56 @@ def make_train_step(
     arrays are sharded on ``axis`` and ``state`` is replicated.  The
     gradient all-reduce is implicit in differentiating the global-batch
     mean loss.
+
+    ``accum_steps > 1`` enables gradient accumulation (beyond the
+    reference, which has no analog): the batch's leading dim is split
+    into ``accum_steps`` microbatches processed by a ``lax.scan`` —
+    activations for only ONE microbatch are live at a time, so the same
+    device memory trains an ``accum_steps``× larger effective batch.
+    Gradients are averaged over microbatches (identical semantics to one
+    big batch for mean losses); mutable model state (BatchNorm stats)
+    threads through the scan sequentially.
     """
     repl = NamedSharding(mesh, P())
     shard = NamedSharding(mesh, P(axis))
     with_rng = _accepts_rng(loss_fn)
 
-    def step(state: TrainState, batch):
-        def lossf(params):
+    def grad_of(params, mstate, batch, step_idx):
+        def lossf(p):
             if with_rng:
                 # per-step dropout/drop-path stream, identical on every
                 # device (replicated state.step → replicated key)
-                rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
-                return loss_fn(params, state.model_state, batch, True, rng=rng)
-            return loss_fn(params, state.model_state, batch, True)
+                rng = jax.random.fold_in(jax.random.PRNGKey(0), step_idx)
+                return loss_fn(p, mstate, batch, True, rng=rng)
+            return loss_fn(p, mstate, batch, True)
 
-        (loss, (new_mstate, _)), grads = jax.value_and_grad(lossf, has_aux=True)(
-            state.params
-        )
+        return jax.value_and_grad(lossf, has_aux=True)(params)
+
+    def step(state: TrainState, batch):
+        if accum_steps == 1:
+            (loss, (new_mstate, _)), grads = grad_of(
+                state.params, state.model_state, batch, state.step
+            )
+        else:
+            micro = jax.tree.map(
+                lambda x: x.reshape(accum_steps, x.shape[0] // accum_steps, *x.shape[1:]),
+                batch,
+            )
+
+            def body(carry, mb):
+                mstate, gsum, lsum, i = carry
+                (l, (mstate, _)), g = grad_of(
+                    state.params, mstate, mb, state.step * accum_steps + i
+                )
+                gsum = jax.tree.map(jnp.add, gsum, g)
+                return (mstate, gsum, lsum + l, i + 1), None
+
+            gzero = jax.tree.map(jnp.zeros_like, state.params)
+            (new_mstate, gsum, lsum, _), _ = jax.lax.scan(
+                body, (state.model_state, gzero, 0.0, 0), micro
+            )
+            grads = jax.tree.map(lambda g: g / accum_steps, gsum)
+            loss = lsum / accum_steps
         new_params, new_opt = optimizer.apply(
             state.params, grads, state.opt_state, state.step
         )

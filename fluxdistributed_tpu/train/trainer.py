@@ -82,6 +82,7 @@ def prepare_training(
     spmd: str = "jit",
     donate: bool = False,
     topk: Sequence[int] = (1, 5, 10),
+    accum_steps: int = 1,
 ) -> TrainTask:
     """Initialize params, compile the SPMD step, build prefetch loaders.
 
@@ -115,10 +116,15 @@ def prepare_training(
 
     loss_fn = flax_loss_fn(model, loss)
     if spmd == "shard_map":
+        if accum_steps != 1:
+            raise ValueError("accum_steps > 1 requires spmd='jit'")
         from ..parallel.dp import make_train_step_shardmap as maker
+
+        step_fn = maker(loss_fn, optimizer, mesh, donate=donate)
     else:
-        maker = make_train_step
-    step_fn = maker(loss_fn, optimizer, mesh, donate=donate)
+        step_fn = make_train_step(
+            loss_fn, optimizer, mesh, donate=donate, accum_steps=accum_steps
+        )
     eval_fn = make_eval_step(loss_fn, mesh, topk=tuple(topk))
 
     state = TrainState.create(

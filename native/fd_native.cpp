@@ -37,7 +37,7 @@
 
 extern "C" {
 
-int fd_version() { return 2; }
+int fd_version() { return 3; }
 
 void fd_free(void* p) { std::free(p); }
 
@@ -212,28 +212,77 @@ void resize_rgb(const uint8_t* src, int h, int w, float* dst, int nh, int nw) {
   }
 }
 
+// Pixel crop rect from relative RandomResizedCrop params.  Shared
+// contract with the Python implementation (_aug_rect in preprocess.py)
+// — keep the two in sync.
+void aug_rect(int h, int w, float area, float ratio, float u, float v,
+              int* y0, int* x0, int* ch, int* cw) {
+  const double target = double(area) * h * w;
+  int tw = int(std::lround(std::sqrt(target * ratio)));
+  int th = int(std::lround(std::sqrt(target / ratio)));
+  if (tw < 1 || th < 1 || tw > w || th > h) {
+    const int side = std::min(h, w);
+    *y0 = (h - side) / 2;
+    *x0 = (w - side) / 2;
+    *ch = side;
+    *cw = side;
+    return;
+  }
+  *y0 = int(std::lround(double(v) * (h - th)));
+  *x0 = int(std::lround(double(u) * (w - tw)));
+  *ch = th;
+  *cw = tw;
+}
+
 // resize smallest side → `resize`, center-crop `crop`, normalize.
 // out: crop*crop*3 float32.  compat = reference double-normalize quirk.
+// aug: optional 5 floats {area, ratio, u, v, flip} switching the
+// geometric stage to RandomResizedCrop+hflip (train augmentation).
 void preprocess_rgb(const uint8_t* rgb, int h, int w, int resize, int crop,
                     const float* mean, const float* stdv, int compat,
-                    float* out) {
-  const double scale = double(resize) / std::min(h, w);
-  int nh = std::max(resize, int(std::lround(h * scale)));
-  int nw = std::max(resize, int(std::lround(w * scale)));
-  std::vector<float> resized(size_t(nh) * nw * 3);
-  if (nh == h && nw == w) {
-    for (size_t i = 0; i < resized.size(); ++i) resized[i] = float(rgb[i]);
+                    float* out, const float* aug) {
+  std::vector<float> resized;
+  int nw, top, left;
+  bool flip = false;
+  if (aug && aug[0] > 0.f) {
+    int y0, x0, ch0, cw0;
+    aug_rect(h, w, aug[0], aug[1], aug[2], aug[3], &y0, &x0, &ch0, &cw0);
+    flip = aug[4] >= 0.5f;
+    // crop the rect, then resize the region directly to crop×crop
+    std::vector<uint8_t> region(size_t(ch0) * cw0 * 3);
+    for (int y = 0; y < ch0; ++y)
+      std::memcpy(region.data() + size_t(y) * cw0 * 3,
+                  rgb + (size_t(y0 + y) * w + x0) * 3, size_t(cw0) * 3);
+    resized.resize(size_t(crop) * crop * 3);
+    if (ch0 == crop && cw0 == crop) {
+      for (size_t i = 0; i < resized.size(); ++i) resized[i] = float(region[i]);
+    } else {
+      resize_rgb(region.data(), ch0, cw0, resized.data(), crop, crop);
+    }
+    nw = crop;
+    top = 0;
+    left = 0;
   } else {
-    resize_rgb(rgb, h, w, resized.data(), nh, nw);
+    const double scale = double(resize) / std::min(h, w);
+    int nh = std::max(resize, int(std::lround(h * scale)));
+    nw = std::max(resize, int(std::lround(w * scale)));
+    resized.resize(size_t(nh) * nw * 3);
+    if (nh == h && nw == w) {
+      for (size_t i = 0; i < resized.size(); ++i) resized[i] = float(rgb[i]);
+    } else {
+      resize_rgb(rgb, h, w, resized.data(), nh, nw);
+    }
+    top = (nh - crop) / 2;
+    left = (nw - crop) / 2;
   }
-  const int top = (nh - crop) / 2, left = (nw - crop) / 2;
   const float inv255 = 1.f / 255.f;
   for (int y = 0; y < crop; ++y) {
     const float* srow = resized.data() + (size_t(top + y) * nw + left) * 3;
     float* drow = out + size_t(y) * crop * 3;
     for (int x = 0; x < crop; ++x) {
+      const int sx = flip ? (crop - 1 - x) : x;
       for (int ch = 0; ch < 3; ++ch) {
-        float v = srow[3 * x + ch] * inv255;
+        float v = srow[3 * sx + ch] * inv255;
         drow[3 * x + ch] = (v - mean[ch]) / stdv[ch];
       }
     }
@@ -279,12 +328,13 @@ bool read_file(const char* path, std::vector<uint8_t>* buf) {
 
 extern "C" {
 
-// Decode + preprocess one in-memory RGB image.
+// Decode + preprocess one in-memory RGB image.  aug: NULL or 5 floats
+// {area, ratio, u, v, flip} enabling RandomResizedCrop+hflip.
 int fd_preprocess_rgb(const uint8_t* rgb, int h, int w, int resize, int crop,
                       const float* mean, const float* stdv, int compat,
-                      float* out) {
+                      float* out, const float* aug) {
   if (!rgb || !out || h < 1 || w < 1 || crop > resize) return 1;
-  preprocess_rgb(rgb, h, w, resize, crop, mean, stdv, compat, out);
+  preprocess_rgb(rgb, h, w, resize, crop, mean, stdv, compat, out, aug);
   return 0;
 }
 
@@ -303,11 +353,12 @@ int fd_decode_jpeg_file(const char* path, uint8_t** out, int* h, int* w) {
 // Threaded with an atomic work queue.  Returns the number of failed
 // images (their slots are zero-filled and flagged in `failed` when
 // non-null, so the caller can re-load them through a fallback decoder);
-// errbuf holds the first error.
+// errbuf holds the first error.  augs: NULL (eval path) or n×5 floats
+// of per-image RandomResizedCrop+flip parameters.
 int fd_load_batch(const char** paths, int n, int resize, int crop,
                   const float* mean, const float* stdv, int compat,
                   float* out, int nthreads, char* errbuf, int errlen,
-                  unsigned char* failed) {
+                  unsigned char* failed, const float* augs) {
   if (n <= 0) return 0;
   if (!out || crop < 1 || resize < 1 || crop > resize) {
     if (errbuf && errlen > 0)
@@ -346,7 +397,8 @@ int fd_load_batch(const char** paths, int n, int resize, int crop,
         continue;
       }
       if (failed) failed[i] = 0;
-      preprocess_rgb(rgb, h, w, resize, crop, mean, stdv, compat, dst);
+      preprocess_rgb(rgb, h, w, resize, crop, mean, stdv, compat, dst,
+                     augs ? augs + size_t(i) * 5 : nullptr);
       std::free(rgb);
     }
   };
