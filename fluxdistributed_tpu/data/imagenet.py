@@ -27,7 +27,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from .preprocess import preprocess
+from .preprocess import preprocess, sample_augment_params
 
 __all__ = ["LabelTable", "SampleTable", "labels", "train_solutions", "makepaths", "ImageNetDataset"]
 
@@ -88,11 +88,17 @@ def train_solutions(
     csv_path: str,
     label_table: LabelTable,
     classes: Optional[Sequence[str]] = None,
+    split: str = "train",
 ) -> SampleTable:
     """Parse ``LOC_train_solution.csv`` (columns ``ImageId,
     PredictionString`` where the prediction string starts with the wnid),
     keeping rows whose class is in ``classes`` (all classes if None) —
-    the reference's class filter (src/imagenet.jl:58-75)."""
+    the reference's class filter (src/imagenet.jl:58-75).
+
+    ``split`` stamps the resulting table (``LOC_val_solution.csv`` has
+    the same schema); it controls both the file layout (``makepaths``)
+    and whether ``ImageNetDataset`` augments by default.
+    """
     keep = set(classes) if classes is not None else None
     ids, cls = [], []
     with open(csv_path, newline="") as f:
@@ -104,7 +110,7 @@ def train_solutions(
                 continue
             ids.append(row["ImageId"])
             cls.append(label_table.class_idx[wnid])
-    return SampleTable(np.asarray(ids, object), np.asarray(cls, np.int32))
+    return SampleTable(np.asarray(ids, object), np.asarray(cls, np.int32), split)
 
 
 def makepaths(image_id: str, root: str, split: str = "train") -> str:
@@ -125,6 +131,13 @@ class ImageNetDataset:
     decodes + preprocesses each image on a worker thread into a
     preallocated ``(n, crop, crop, 3)`` float32 array (:37-48), and
     returns integer labels (the loader one-hots them).
+
+    ``augment`` (default: on for the train split) switches the geometric
+    stage to torchvision-style RandomResizedCrop + p=0.5 hflip — the
+    train-time augmentation the reference lacks but the 75.9% top-1
+    target requires.  Params are sampled in Python from the batch RNG
+    (after the index draw), so the native and PIL backends produce
+    identical batches for identical ``(rng_state, indices)``.
     """
 
     def __init__(
@@ -137,6 +150,7 @@ class ImageNetDataset:
         compat_double_normalize: bool = False,
         num_threads: int = 8,
         use_native: Optional[bool] = None,
+        augment: Optional[bool] = None,
     ):
         self.root = root
         self.table = table
@@ -151,6 +165,9 @@ class ImageNetDataset:
 
             use_native = _native.available()
         self.use_native = use_native
+        if augment is None:
+            augment = table.split == "train"
+        self.augment = augment
 
     def __len__(self):
         return len(self.table)
@@ -169,19 +186,23 @@ class ImageNetDataset:
     def __exit__(self, *exc):
         self.close()
 
-    def _load_one(self, out: np.ndarray, i: int, image_id: str):
+    def _load_one(self, out: np.ndarray, i: int, image_id: str, aug=None):
         path = makepaths(image_id, self.root, self.table.split)
         out[i] = preprocess(
             path,
             crop=self.crop,
             resize=self.resize,
             compat_double_normalize=self.compat,
+            augment=aug,
         )
 
     def batch(self, rng: np.random.Generator, n: int, indices=None):
         if indices is None:
             indices = rng.integers(0, len(self.table), size=n)
         indices = np.asarray(indices)
+        # one RandomResizedCrop+flip draw per slot, consumed identically
+        # by both backends (and by the native path's PIL fallback)
+        augs = sample_augment_params(rng, len(indices)) if self.augment else None
         if self.use_native:
             from . import native as _native
 
@@ -197,11 +218,13 @@ class ImageNetDataset:
                 resize=self.resize,
                 compat_double_normalize=self.compat,
                 num_threads=self._num_threads,
-                fallback=lambda p: preprocess(
+                augs=augs,
+                fallback=lambda p, aug=None: preprocess(
                     p,
                     crop=self.crop,
                     resize=self.resize,
                     compat_double_normalize=self.compat,
+                    augment=aug,
                 ),
             )
             return arr, self.table.class_idx[indices]
@@ -209,7 +232,10 @@ class ImageNetDataset:
             self._pool = ThreadPoolExecutor(max_workers=self._num_threads)
         arr = np.zeros((len(indices), self.crop, self.crop, 3), np.float32)
         futures = [
-            self._pool.submit(self._load_one, arr, i, self.table.image_ids[j])
+            self._pool.submit(
+                self._load_one, arr, i, self.table.image_ids[j],
+                augs[i] if augs is not None else None,
+            )
             for i, j in enumerate(indices)
         ]
         for f in futures:

@@ -134,6 +134,17 @@ def _norm_params(mean, std):
     return m, s
 
 
+def _aug_ptr(augment, expected_shape):
+    """None → NULL; else a C-contiguous float32 array of aug params and
+    its pointer (the array must stay referenced for the call's lifetime)."""
+    if augment is None:
+        return None, None
+    arr = np.ascontiguousarray(augment, np.float32)
+    if arr.shape != expected_shape:
+        raise ValueError(f"augment params must have shape {expected_shape}, got {arr.shape}")
+    return arr, _fp(arr)
+
+
 def preprocess_rgb(
     rgb: np.ndarray,
     crop: int = 224,
@@ -141,8 +152,14 @@ def preprocess_rgb(
     mean: Sequence[float] = IMAGENET_MEAN,
     std: Sequence[float] = IMAGENET_STD,
     compat_double_normalize: bool = False,
+    augment=None,
 ) -> np.ndarray:
-    """Native resize→crop→normalize for one HWC uint8 RGB array."""
+    """Native resize→crop→normalize for one HWC uint8 RGB array.
+
+    ``augment``: optional 5-vector ``(area, ratio, u, v, flip)`` from
+    ``preprocess.sample_augment_params`` switching the geometric stage to
+    RandomResizedCrop+hflip (train path); None is the eval path.
+    """
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
@@ -150,11 +167,13 @@ def preprocess_rgb(
     h, w = rgb.shape[:2]
     out = np.empty((crop, crop, 3), np.float32)
     m, s = _norm_params(mean, std)
+    aug_arr, aug_p = _aug_ptr(augment, (5,))
     rc = lib.fd_preprocess_rgb(
         rgb.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), h, w,
         resize, crop, _fp(m), _fp(s),
-        1 if compat_double_normalize else 0, _fp(out),
+        1 if compat_double_normalize else 0, _fp(out), aug_p,
     )
+    del aug_arr
     if rc != 0:
         raise ValueError(f"fd_preprocess_rgb failed (rc={rc})")
     return out
@@ -190,7 +209,8 @@ def load_batch(
     num_threads: int = 8,
     out: Optional[np.ndarray] = None,
     strict: bool = True,
-    fallback: Optional[Callable[[str], np.ndarray]] = None,
+    fallback: Optional[Callable[..., np.ndarray]] = None,
+    augs: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """Full native pipeline for a list of JPEG files → (N, crop, crop, 3).
 
@@ -202,6 +222,12 @@ def load_batch(
     files degrade to the slow path instead of poisoning the batch.  With
     ``strict`` (default) anything still failing after the fallback
     raises; otherwise those slots stay zero-filled.
+
+    ``augs``: optional ``(N, 5)`` float32 of per-image
+    ``sample_augment_params`` rows enabling RandomResizedCrop+hflip
+    (train path).  When given, the fallback is called as
+    ``fallback(path, aug_row)`` so slow-path slots see the same
+    augmentation.
     """
     lib = _load()
     if lib is None:
@@ -222,11 +248,12 @@ def load_batch(
     m, s = _norm_params(mean, std)
     errbuf = ctypes.create_string_buffer(512)
     failed = np.zeros(n, np.uint8)
+    aug_arr, aug_p = _aug_ptr(augs, (n, 5))
     failures = lib.fd_load_batch(
         arr, n, resize, crop, _fp(m), _fp(s),
         1 if compat_double_normalize else 0, _fp(out),
         num_threads, errbuf, len(errbuf),
-        failed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        failed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), aug_p,
     )
     if failures:
         still_failed = []
@@ -234,7 +261,10 @@ def load_batch(
         for i in np.nonzero(failed)[0]:
             if fallback is not None:
                 try:
-                    out[i] = fallback(paths[i])
+                    if aug_arr is None:
+                        out[i] = fallback(paths[i])
+                    else:
+                        out[i] = fallback(paths[i], aug_arr[i])
                     continue
                 except Exception as e:  # noqa: BLE001 — any decode error → slot failed
                     first_fb_err = first_fb_err or e

@@ -154,7 +154,10 @@ def preprocess(
     """
     if not isinstance(img, np.ndarray):
         img = decode_image(img)
-    if augment is not None:
+    # area <= 0 means "no augmentation" — the same gate the native
+    # executor applies (fd_native.cpp: `aug && aug[0] > 0.f`), so
+    # degenerate rows behave identically on both backends.
+    if augment is not None and float(augment[0]) > 0:
         img = random_resized_crop(img, crop, augment)
     else:
         img = resize_smallest_dimension(img, resize)

@@ -33,12 +33,20 @@ def _driver_imagenet(spec: dict):
     from .imagenet import ImageNetDataset, labels, train_solutions
 
     root = spec["path"]
+    split = spec.get("split", "train")
     lt = labels(spec.get("synset_mapping", os.path.join(root, "LOC_synset_mapping.txt")))
+    default_csv = os.path.join(root, f"LOC_{split}_solution.csv")
     table = train_solutions(
-        spec.get("train_solution", os.path.join(root, "LOC_train_solution.csv")),
+        spec.get("solution_csv", spec.get("train_solution", default_csv)),
         lt,
         classes=spec.get("classes"),
+        split=split,
     )
+    kwargs = {}
+    for k in ("augment", "use_native"):
+        # None keeps the dataset's auto/per-split default
+        if spec.get(k) is not None:
+            kwargs[k] = bool(spec[k])
     return ImageNetDataset(
         root,
         table,
@@ -46,6 +54,8 @@ def _driver_imagenet(spec: dict):
         crop=int(spec.get("crop", 224)),
         resize=int(spec.get("resize", 256)),
         compat_double_normalize=bool(spec.get("compat_double_normalize", False)),
+        num_threads=int(spec.get("num_threads", 8)),
+        **kwargs,
     )
 
 

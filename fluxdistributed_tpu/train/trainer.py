@@ -147,7 +147,17 @@ def prepare_training(
     if val_dataset is not None:
         n = mesh.shape[mesh_lib.DATA_AXIS]
         nval = max(n, (val_samples // n) * n)  # divisible val slice
-        vi, vl = val_dataset.batch(np.random.default_rng(seed + 1), nval)
+        # Validation must go through the eval pipeline even when the val
+        # dataset was carved from an augmenting train table — force train
+        # augmentation off for this draw.
+        was_augment = getattr(val_dataset, "augment", False)
+        if was_augment:
+            val_dataset.augment = False
+        try:
+            vi, vl = val_dataset.batch(np.random.default_rng(seed + 1), nval)
+        finally:
+            if was_augment:
+                val_dataset.augment = True
         val_batch = sharding_lib.shard_batch(
             {"image": vi, "label": np.asarray(onehot(vl, val_dataset.nclasses))}, mesh
         )

@@ -172,3 +172,25 @@ def test_cifar10_binary_loader(tmp_path):
     assert len(test) == 2
     with pytest.raises(FileNotFoundError, match="binary"):
         CIFAR10Dataset(str(tmp_path / "missing"))
+
+
+def test_registry_split_and_augment_keys(imagenet_root):
+    """The registry plumbs split/augment through to ImageNetDataset:
+    split selects the solution CSV + file layout and augment overrides
+    the per-split default."""
+    from fluxdistributed_tpu.data.registry import register_dataset
+
+    register_dataset("inet_train", "imagenet", path=imagenet_root, crop=32, resize=40)
+    ds = open_dataset("inet_train")
+    assert ds.table.split == "train" and ds.augment is True
+    ds2 = open_dataset("inet_train", augment=False)
+    assert ds2.augment is False
+    # a val registration reuses the same CSV via solution_csv but stamps
+    # the val split → augment defaults off
+    register_dataset(
+        "inet_val", "imagenet", path=imagenet_root, split="val",
+        solution_csv=os.path.join(imagenet_root, "LOC_train_solution.csv"),
+        crop=32, resize=40,
+    )
+    dv = open_dataset("inet_val")
+    assert dv.table.split == "val" and dv.augment is False

@@ -165,3 +165,30 @@ def test_oom_detection_helper():
 
     assert _is_oom(RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating"))
     assert not _is_oom(RuntimeError("invalid argument"))
+
+
+def test_val_batch_sampled_without_augmentation(mesh):
+    """A val dataset carved from an augmenting train table must be
+    evaluated through the eval pipeline: prepare_training forces
+    augment off for the fixed val draw, then restores it."""
+
+    class AugRecordingDataset(SyntheticDataset):
+        def __init__(self):
+            super().__init__(nsamples=64, nclasses=10, shape=(4, 4, 3))
+            self.augment = True
+            self.augment_during_batch = []
+
+        def batch(self, rng, n, indices=None):
+            self.augment_during_batch.append(self.augment)
+            return super().batch(rng, n, indices)
+
+    ds = SyntheticDataset(nsamples=64, nclasses=10, shape=(4, 4, 3))
+    val = AugRecordingDataset()
+    task = prepare_training(
+        SimpleCNN(num_classes=10), ds, optim.momentum(0.1, 0.9),
+        mesh=mesh, batch_size=8, cycles=1, val_dataset=val, val_samples=8,
+        input_shape=(8, 4, 4, 3),
+    )
+    assert task.val_batch is not None
+    assert val.augment_during_batch == [False]  # draw ran unaugmented
+    assert val.augment is True  # and the flag was restored
