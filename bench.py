@@ -18,7 +18,9 @@ compare against).  Update it when the bench improves materially.
 from __future__ import annotations
 
 import json
+import sys
 import time
+import traceback
 
 # First recorded value on the one available chip (TPU v5e, global batch
 # 256, bf16): ~2270 img/s/chip, reproduced across three bench runs
@@ -27,7 +29,7 @@ import time
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 2270.0
 
 
-def main():
+def _measure():
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -87,13 +89,42 @@ def main():
         if BASELINE_IMAGES_PER_SEC_PER_CHIP
         else 1.0
     )
+    return {
+        "metric": f"ResNet-50 train-step throughput ({platform}, global batch {batch}, bf16)",
+        "value": round(ips_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }
+
+
+def main():
+    # The driver records rc and the last JSON line; transient runtime
+    # failures (e.g. "remote_compile: read body: response body closed",
+    # BENCH_r02) must never surface as rc!=0 with no JSON.  Retry the full
+    # measurement a few times, and if every attempt dies, still emit the
+    # JSON line with an "error" field and exit 0.
+    last_err = None
+    deadline = time.monotonic() + 420  # leave headroom under driver timeouts
+    for attempt in range(3):
+        try:
+            print(json.dumps(_measure()))
+            return
+        except Exception as e:  # noqa: BLE001 — any failure is retryable here
+            last_err = e
+            traceback.print_exc(file=sys.stderr)
+            if attempt == 2 or time.monotonic() > deadline:
+                print("bench: giving up, emitting error JSON", file=sys.stderr)
+                break
+            print(f"bench attempt {attempt + 1} failed; retrying", file=sys.stderr)
+            time.sleep(5)
     print(
         json.dumps(
             {
-                "metric": f"ResNet-50 train-step throughput ({platform}, global batch {batch}, bf16)",
-                "value": round(ips_per_chip, 2),
+                "metric": "ResNet-50 train-step throughput",
+                "value": 0.0,
                 "unit": "images/sec/chip",
-                "vs_baseline": round(vs, 3),
+                "vs_baseline": 0.0,
+                "error": f"{type(last_err).__name__}: {last_err}",
             }
         )
     )

@@ -110,10 +110,18 @@ def force_host_devices(n: int = 8) -> None:
     slice run on N host devices (the analog of the reference's CPU
     fake-device mode, src/utils.jl:1-18 + test/single_device.jl:144-150).
     """
+    import re
+
     flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n}"
-        ).strip()
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if "xla_force_host_platform_device_count" in flags:
+        # Rewrite a pre-set count rather than substring-skip it: the
+        # pre-set value may differ from ``n``.
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, flags
+        )
+    else:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
     os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
