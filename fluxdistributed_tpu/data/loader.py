@@ -91,7 +91,14 @@ class PrefetchLoader:
         self.cycles = cycles
 
     # -- host-side batch assembly ------------------------------------
-    def _make_batch(self, rng: np.random.Generator):
+    def _make_batch(self, i: int):
+        # Per-batch stream keyed on (seed, process, batch index): batch
+        # content is a pure function of the index, so runs with the same
+        # seed are bit-reproducible no matter which prefetch thread
+        # assembles which batch.  Distinct per process, so hosts sample
+        # different rows (the analog of the reference's per-worker
+        # sampling, src/sync.jl:135).
+        rng = np.random.default_rng((self.seed, jax.process_index(), i))
         imgs, labels = self.dataset.batch(rng, self._local_batch)
         if self.transform is not None:
             imgs, labels = self.transform(imgs, labels)
@@ -120,19 +127,23 @@ class PrefetchLoader:
         lock = threading.Lock()
         stop = threading.Event()
 
+        # Backpressure: workers may run at most ``buffersize`` batches
+        # ahead of the consumer (the reorder buffer would otherwise grow
+        # unboundedly while the consumer waits on one slow index, holding
+        # arbitrarily many device-resident batches in HBM).
+        ahead = threading.Semaphore(self.buffersize)
+
         def worker(tid: int):
-            # distinct stream per (process, thread) so hosts sample
-            # different rows, like the reference's per-worker sampling
-            rng = np.random.default_rng(
-                self.seed * 1_000_003 + jax.process_index() * 7919 + tid
-            )
             while not stop.is_set():
+                if not ahead.acquire(timeout=0.5):
+                    continue
                 with lock:
                     i = next(counter, None)
                 if i is None:
+                    ahead.release()
                     break
                 try:
-                    imgs, labels = self._make_batch(rng)
+                    imgs, labels = self._make_batch(i)
                     # device_put from a worker thread: transfer overlaps
                     # the consumer's compute, like the reference's
                     # prefetch tasks
@@ -155,14 +166,22 @@ class PrefetchLoader:
         for t in threads:
             t.start()
 
-        delivered = 0
+        # Deliver strictly in batch-index order (threads may finish out of
+        # order): determinism costs only a small reorder buffer.
+        pending: dict = {}
+        next_idx = 0
         try:
-            while delivered < self.cycles:
-                _, batch, err = q.get()
-                if err is not None:
-                    raise RuntimeError("prefetch worker failed while assembling a batch") from err
-                delivered += 1
-                yield batch
+            while next_idx < self.cycles:
+                while next_idx not in pending:
+                    i, batch, err = q.get()
+                    if err is not None:
+                        raise RuntimeError(
+                            "prefetch worker failed while assembling a batch"
+                        ) from err
+                    pending[i] = batch
+                yield pending.pop(next_idx)
+                next_idx += 1
+                ahead.release()
         finally:
             stop.set()
             for t in threads:

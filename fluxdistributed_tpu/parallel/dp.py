@@ -123,6 +123,7 @@ def make_train_step(
     axis: str = mesh_lib.DATA_AXIS,
     donate: bool = True,
     accum_steps: int = 1,
+    seed: int = 0,
 ):
     """Compile the full DP training step under ``jit`` + shardings.
 
@@ -139,6 +140,9 @@ def make_train_step(
     Gradients are averaged over microbatches (identical semantics to one
     big batch for mean losses); mutable model state (BatchNorm stats)
     threads through the scan sequentially.
+
+    ``seed`` roots the dropout/drop-path stream: two seeds draw different
+    masks, the same seed reproduces a run exactly.
     """
     repl = NamedSharding(mesh, P())
     shard = NamedSharding(mesh, P(axis))
@@ -147,9 +151,10 @@ def make_train_step(
     def grad_of(params, mstate, batch, step_idx):
         def lossf(p):
             if with_rng:
-                # per-step dropout/drop-path stream, identical on every
-                # device (replicated state.step → replicated key)
-                rng = jax.random.fold_in(jax.random.PRNGKey(0), step_idx)
+                # per-step dropout/drop-path stream rooted at the user
+                # seed, identical on every device (replicated state.step
+                # → replicated key)
+                rng = jax.random.fold_in(jax.random.PRNGKey(seed), step_idx)
                 return loss_fn(p, mstate, batch, True, rng=rng)
             return loss_fn(p, mstate, batch, True)
 
@@ -236,6 +241,7 @@ def make_train_step_shardmap(
     mesh: Mesh,
     axis: str = mesh_lib.DATA_AXIS,
     donate: bool = True,
+    seed: int = 0,
 ):
     """Explicit-SPMD DP step: per-device gradients + ``pmean``.
 
@@ -263,9 +269,10 @@ def make_train_step_shardmap(
         def lossf(params):
             if with_rng:
                 # distinct stream per device so each batch shard draws
-                # independent dropout/drop-path masks
+                # independent dropout/drop-path masks, rooted at the
+                # user seed
                 rng = jax.random.fold_in(
-                    jax.random.fold_in(jax.random.PRNGKey(0), state.step),
+                    jax.random.fold_in(jax.random.PRNGKey(seed), state.step),
                     jax.lax.axis_index(axis),
                 )
                 return loss_fn(params, state.model_state, batch, True, rng=rng)

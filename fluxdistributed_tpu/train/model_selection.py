@@ -42,15 +42,12 @@ class SelectionTask:
     params: Pytree  # stacked (R, ...) leaves, sharded on the replica axis
     opt_state: Pytree
     model_state: Pytree
+    dropout_keys: Pytree  # (R, ...) per-replica dropout streams
     step_fn: Callable
     select_fn: Callable
     mesh: Mesh
     model: Any
     replicas: int
-
-
-def _stack(tree: Pytree, r: int) -> Pytree:
-    return jax.tree.map(lambda x: jnp.broadcast_to(x[None], (r, *x.shape)), tree)
 
 
 def prepare_model_selection(
@@ -77,6 +74,10 @@ def prepare_model_selection(
 
     dummy = np.zeros((1, *input_shape), np.float32)
     keys = jax.random.split(jax.random.PRNGKey(seed), r)
+    # Independent per-replica dropout streams (distinct from the init
+    # keys): each replica must draw its own masks, or the ensemble's
+    # "independent basin exploration" rationale collapses.
+    dropout_keys = jax.random.split(jax.random.fold_in(jax.random.PRNGKey(seed), 1), r)
 
     def init_one(key):
         p_rng, d_rng = jax.random.split(key)
@@ -87,13 +88,15 @@ def prepare_model_selection(
 
     params, opt_state, model_state = jax.vmap(init_one)(keys)
     rep = NamedSharding(mesh, P(axis))  # replica-axis sharding
-    params, opt_state, model_state = jax.device_put((params, opt_state, model_state), rep)
+    params, opt_state, model_state, dropout_keys = jax.device_put(
+        (params, opt_state, model_state, dropout_keys), rep
+    )
 
     loss_fn = flax_loss_fn(model, loss)
 
-    def one_step(params, opt_state, mstate, batch, step):
+    def one_step(params, opt_state, mstate, batch, step, key):
         def lossf(p):
-            rng = jax.random.fold_in(jax.random.PRNGKey(1), step)
+            rng = jax.random.fold_in(key, step)
             return loss_fn(p, mstate, batch, True, rng=rng)
 
         (l, (new_mstate, _)), grads = jax.value_and_grad(lossf, has_aux=True)(params)
@@ -102,7 +105,9 @@ def prepare_model_selection(
 
     # vmap over the stacked replica axis: R independent training steps in
     # one compiled program (the ``asyncmap`` over workers, src/test.jl:33).
-    vstep = jax.vmap(one_step, in_axes=(0, 0, 0, 0, None))
+    # The per-replica dropout key is vmapped in so replicas draw
+    # independent masks.
+    vstep = jax.vmap(one_step, in_axes=(0, 0, 0, 0, None, 0))
     step_fn = jax.jit(vstep)
 
     def select(params, opt_state, mstate, val_batch):
@@ -129,6 +134,7 @@ def prepare_model_selection(
         params=params,
         opt_state=opt_state,
         model_state=model_state,
+        dropout_keys=dropout_keys,
         step_fn=step_fn,
         select_fn=select_fn,
         mesh=mesh,
@@ -178,7 +184,8 @@ def train_model_selection(
                 batch, NamedSharding(task.mesh, P(mesh_lib.DATA_AXIS))
             )
             task.params, task.opt_state, task.model_state, train_losses = task.step_fn(
-                task.params, task.opt_state, task.model_state, batch, step
+                task.params, task.opt_state, task.model_state, batch, step,
+                task.dropout_keys,
             )
             step = step + 1
         task.params, task.opt_state, task.model_state, val_losses = task.select_fn(

@@ -120,10 +120,10 @@ def prepare_training(
             raise ValueError("accum_steps > 1 requires spmd='jit'")
         from ..parallel.dp import make_train_step_shardmap as maker
 
-        step_fn = maker(loss_fn, optimizer, mesh, donate=donate)
+        step_fn = maker(loss_fn, optimizer, mesh, donate=donate, seed=seed)
     else:
         step_fn = make_train_step(
-            loss_fn, optimizer, mesh, donate=donate, accum_steps=accum_steps
+            loss_fn, optimizer, mesh, donate=donate, accum_steps=accum_steps, seed=seed
         )
     eval_fn = make_eval_step(loss_fn, mesh, topk=tuple(topk))
 

@@ -1,0 +1,106 @@
+"""OOM fault-tolerance integration tests.
+
+The reference catches device OOM inside its task loop and skips the
+batch (src/ddp_tasks.jl:230-238) with a ``num_missed`` counter that is
+declared but never incremented (:178, :240).  Here the counter is live
+and the two guard branches (donated state, multi-host) raise with clear
+messages — these tests exercise all three paths by injecting a failing
+step_fn, the analog of the reference's ``TaskFailedException`` wrapping.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fluxdistributed_tpu import optim
+from fluxdistributed_tpu.data import SyntheticDataset
+from fluxdistributed_tpu.models import resnet18
+from fluxdistributed_tpu.train import prepare_training, train
+from fluxdistributed_tpu.train.logging import NullLogger
+
+
+def _task(cycles=4, donate=False):
+    ds = SyntheticDataset(nsamples=64, nclasses=10, shape=(16, 16, 3))
+    return prepare_training(
+        resnet18(num_classes=10, dtype=jnp.float32),
+        ds,
+        optim.momentum(0.1, 0.9),
+        batch_size=16,
+        cycles=cycles,
+        donate=donate,
+    )
+
+
+class _FakeOOM(Exception):
+    pass
+
+
+def _inject_oom_once(task, msg="RESOURCE_EXHAUSTED: fake injected OOM"):
+    real = task.step_fn
+    calls = {"n": 0}
+
+    def failing(state, batch):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise _FakeOOM(msg)
+        return real(state, batch)
+
+    task.step_fn = failing
+    return calls
+
+
+def test_oom_skips_batch_and_continues():
+    task = _task(cycles=4)
+    _inject_oom_once(task)
+    train(task, print_every=0, eval_every=0, logger=NullLogger())
+    assert task.num_missed == 1
+    # 4 cycles, first skipped -> 3 applied steps
+    assert int(task.state.step) == 3
+
+
+def test_non_oom_errors_propagate():
+    task = _task(cycles=2)
+    _inject_oom_once(task, msg="INVALID_ARGUMENT: something else entirely")
+    with pytest.raises(_FakeOOM):
+        train(task, print_every=0, eval_every=0, logger=NullLogger())
+    assert task.num_missed == 0
+
+
+def test_oom_with_donated_state_raises():
+    class _DeletedLeaf:
+        def is_deleted(self):
+            return True
+
+    task = _task(cycles=2, donate=True)
+
+    def failing(state, batch):
+        # simulate: buffers were donated to the failed execution
+        from fluxdistributed_tpu.parallel.dp import TrainState
+
+        task.state = TrainState(
+            params={"w": _DeletedLeaf()},
+            opt_state=state.opt_state,
+            model_state=state.model_state,
+            step=state.step,
+        )
+        raise _FakeOOM("RESOURCE_EXHAUSTED: fake injected OOM")
+
+    task.step_fn = failing
+    with pytest.raises(RuntimeError, match="donate=True"):
+        train(task, print_every=0, eval_every=0, logger=NullLogger())
+
+
+def test_oom_multihost_raises(monkeypatch):
+    from fluxdistributed_tpu.parallel import multihost
+
+    task = _task(cycles=2)
+    _inject_oom_once(task)
+    # Fake a 2-process world for the trainer's guard; keep the loader's
+    # batch assembly single-process (it would otherwise try to stitch a
+    # half-batch from each "process").
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost, "global_batch_put", jax.device_put)
+    with pytest.raises(RuntimeError, match="multi-host"):
+        train(task, print_every=0, eval_every=0, logger=NullLogger())

@@ -1,0 +1,117 @@
+"""Seed threading into stochastic streams.
+
+The reference has no dropout/drop-path at all (vision CNNs only), so
+this is a framework-specific contract: the ``seed`` passed to
+``prepare_training``/the step makers must root EVERY stochastic stream —
+two seeds draw different masks, the same seed reproduces a run exactly,
+and model-selection replicas draw independent masks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import fluxdistributed_tpu as fd
+from fluxdistributed_tpu import optim, sharding
+from fluxdistributed_tpu.mesh import data_mesh
+from fluxdistributed_tpu.models import vit_tiny
+from fluxdistributed_tpu.parallel import TrainState, make_train_step
+from fluxdistributed_tpu.parallel.dp import flax_loss_fn, make_train_step_shardmap
+
+
+def _one_step_params(maker, seed):
+    """Params after one step of a dropout model, from a fixed init."""
+    mesh = data_mesh()
+    model = vit_tiny(num_classes=10, dropout=0.5, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (16, 32, 32, 3)).astype(np.float32)
+    y = np.asarray(fd.onehot(rng.integers(0, 10, 16), 10))
+    variables = model.init(
+        {"params": jax.random.PRNGKey(7), "dropout": jax.random.PRNGKey(8)},
+        x[:1],
+        train=True,
+    )
+    loss_fn = flax_loss_fn(model, fd.logitcrossentropy)
+    opt = optim.momentum(0.1, 0.9)
+    step = maker(loss_fn, opt, mesh, donate=False, seed=seed)
+    state = TrainState.create(sharding.replicate(variables["params"], mesh), opt)
+    batch = sharding.shard_batch({"image": x, "label": y}, mesh)
+    state, _ = step(state, batch)
+    return jax.tree.map(np.asarray, state.params)
+
+
+def _max_abs_diff(a, b):
+    return max(
+        float(np.max(np.abs(x - y)))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def test_same_seed_reproduces_jit():
+    a = _one_step_params(make_train_step, seed=3)
+    b = _one_step_params(make_train_step, seed=3)
+    assert _max_abs_diff(a, b) == 0.0
+
+
+def test_different_seeds_draw_different_masks_jit():
+    a = _one_step_params(make_train_step, seed=3)
+    b = _one_step_params(make_train_step, seed=4)
+    assert _max_abs_diff(a, b) > 1e-6
+
+
+def test_different_seeds_draw_different_masks_shardmap():
+    a = _one_step_params(make_train_step_shardmap, seed=3)
+    b = _one_step_params(make_train_step_shardmap, seed=4)
+    assert _max_abs_diff(a, b) > 1e-6
+
+
+def test_prepare_training_threads_seed():
+    """End-to-end: prepare_training(seed=...) reaches the dropout stream."""
+    from fluxdistributed_tpu.data import SyntheticDataset
+    from fluxdistributed_tpu.train import prepare_training, train
+    from fluxdistributed_tpu.train.logging import NullLogger
+
+    def run(seed):
+        ds = SyntheticDataset(nsamples=32, nclasses=10, shape=(32, 32, 3))
+        task = prepare_training(
+            vit_tiny(num_classes=10, dropout=0.5, dtype=jnp.float32),
+            ds,
+            optim.momentum(0.1, 0.9),
+            batch_size=16,
+            cycles=2,
+            seed=seed,
+        )
+        params, _, _ = train(task, print_every=0, eval_every=0, logger=NullLogger())
+        return params
+
+    a, b, c = run(0), run(0), run(1)
+    assert _max_abs_diff(a, b) == 0.0  # same seed → bit-identical run
+    assert _max_abs_diff(a, c) > 1e-6  # different seed → different run
+
+
+def test_model_selection_replicas_draw_independent_masks():
+    """Identical params + identical data + dropout → per-replica losses
+    must still differ, because each replica has its own mask stream."""
+    from fluxdistributed_tpu.train.model_selection import prepare_model_selection
+
+    model = vit_tiny(num_classes=10, dropout=0.5, dtype=jnp.float32)
+    task = prepare_model_selection(
+        model, optim.momentum(0.1, 0.9), input_shape=(32, 32, 3), seed=0
+    )
+    r = task.replicas
+    # collapse to identical replicas so only the mask stream can differ
+    params = jax.tree.map(lambda x: jnp.broadcast_to(x[:1], x.shape), task.params)
+    opt_state = jax.tree.map(lambda x: jnp.broadcast_to(x[:1], x.shape), task.opt_state)
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (1, 4, 32, 32, 3)).astype(np.float32)
+    x = jnp.asarray(np.broadcast_to(x, (r, 4, 32, 32, 3)))
+    y = np.asarray(fd.onehot(rng.integers(0, 10, 4), 10))
+    y = jnp.asarray(np.broadcast_to(y[None], (r, 4, 10)))
+    _, _, _, losses = task.step_fn(
+        params, opt_state, task.model_state, {"image": x, "label": y},
+        jnp.zeros((), jnp.int32), task.dropout_keys,
+    )
+    losses = np.asarray(losses)
+    assert np.unique(losses.round(7)).size > 1, losses
