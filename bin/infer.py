@@ -33,6 +33,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="checkpoint dir from the trainer (latest step used; "
                         "random init if omitted)")
     p.add_argument("--step", type=int, default=None, help="specific checkpoint step")
+    p.add_argument("--torch-weights", default=None,
+                   help=".pt/.pth file with a torchvision-layout ResNet "
+                        "state_dict (the pretrained-weight path; analog of "
+                        "the reference's getweights, src/preprocess.jl:9-24)")
     p.add_argument("--synset", default=None,
                    help="LOC_synset_mapping.txt for human-readable labels")
     p.add_argument("--topk", type=int, default=3,
@@ -84,7 +88,23 @@ def main(argv=None) -> int:
         row_names = ["<synthetic>"]
 
     variables = model.init(jax.random.PRNGKey(0), batch[:1], train=False)
-    if args.checkpoint:
+    if args.torch_weights and args.checkpoint:
+        print("--torch-weights and --checkpoint are mutually exclusive", file=sys.stderr)
+        return 2
+    if args.torch_weights:
+        from fluxdistributed_tpu.models.torch_import import load_torch_file
+
+        if not args.model.startswith("resnet") or not args.model[6:].isdigit():
+            print(
+                f"--torch-weights requires a resnet model (resnet18/34/50/101/152), "
+                f"got {args.model!r}",
+                file=sys.stderr,
+            )
+            return 2
+        params, mstate = load_torch_file(args.torch_weights, depth=int(args.model[6:]))
+        variables = {"params": params, **mstate}
+        print(f"loaded torchvision-layout weights from {args.torch_weights}")
+    elif args.checkpoint:
         from fluxdistributed_tpu.train.checkpoint import load_checkpoint
 
         # raw (target-free) restore: works for checkpoints from ANY

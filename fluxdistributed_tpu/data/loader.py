@@ -30,8 +30,6 @@ from ..ops import onehot
 
 __all__ = ["PrefetchLoader"]
 
-_STOP = object()
-
 
 class PrefetchLoader:
     """Iterate device-sharded ``{"image", "label"}`` batches with background prefetch.
@@ -133,7 +131,7 @@ class PrefetchLoader:
         # arbitrarily many device-resident batches in HBM).
         ahead = threading.Semaphore(self.buffersize)
 
-        def worker(tid: int):
+        def worker():
             while not stop.is_set():
                 if not ahead.acquire(timeout=0.5):
                     continue
@@ -160,8 +158,8 @@ class PrefetchLoader:
                     return
 
         threads = [
-            threading.Thread(target=worker, args=(t,), daemon=True)
-            for t in range(self.num_threads)
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(self.num_threads)
         ]
         for t in threads:
             t.start()

@@ -32,6 +32,9 @@ __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152
 ModuleDef = Any
 
 
+_PAD3 = ((1, 1), (1, 1))  # torch-convention padding for 3x3 convs
+
+
 class BasicBlock(nn.Module):
     """3x3 + 3x3 residual block (ResNet-18/34)."""
 
@@ -43,10 +46,12 @@ class BasicBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = self.conv(
+            self.filters, (3, 3), (self.strides, self.strides), padding=_PAD3
+        )(x)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3))(y)
+        y = self.conv(self.filters, (3, 3), padding=_PAD3)(y)
         y = self.norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
             residual = self.conv(
@@ -70,7 +75,9 @@ class BottleneckBlock(nn.Module):
         y = self.conv(self.filters, (1, 1))(x)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = self.conv(
+            self.filters, (3, 3), (self.strides, self.strides), padding=_PAD3
+        )(y)
         y = self.norm()(y)
         y = nn.relu(y)
         y = self.conv(self.filters * 4, (1, 1))(y)
@@ -111,11 +118,17 @@ class ResNet(nn.Module):
             dtype=self.dtype,
             axis_name=self.bn_cross_replica_axis,
         )
+        # Torch-convention explicit padding throughout (stem 3, 3x3 convs
+        # 1, maxpool 1): identical to SAME at stride 1, but at stride 2
+        # SAME pads asymmetrically — explicit padding keeps the model
+        # numerically importable from torchvision-layout weights
+        # (models/torch_import.py), the analog of the reference's
+        # pretrained-weight path (src/preprocess.jl:9-24).
         x = jnp.asarray(x, self.dtype)
-        x = conv(self.width, (7, 7), (2, 2), name="stem_conv")(x)
+        x = conv(self.width, (7, 7), (2, 2), padding=((3, 3), (3, 3)), name="stem_conv")(x)
         x = norm(name="stem_bn")(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for i, nblocks in enumerate(self.stage_sizes):
             for j in range(nblocks):
                 strides = 2 if i > 0 and j == 0 else 1

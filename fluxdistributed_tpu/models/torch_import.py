@@ -1,0 +1,108 @@
+"""Import torchvision-layout ResNet weights into the framework's models.
+
+The reference ships pretrained-weight helpers (``getweights``/``weights``,
+src/preprocess.jl:9-24) that fetch Metalhead BSON weights, and its demo
+loads a trained model for inference (bin/pluto.jl:124).  The TPU-native
+analog: map a **torchvision-format ResNet state_dict** (the de-facto
+public weight layout for ResNets — `conv1.weight`, `layer{1-4}.{i}.*`,
+`fc.*`) onto this framework's flax parameter / batch-stats trees, so
+``bin/infer.py`` can serve real predictions and the model definitions are
+numerically validated against a known-good implementation
+(tests/test_torch_import.py pins logit parity).
+
+No torch dependency at import time: a state_dict is just a mapping of
+names to arrays — anything array-like (torch tensors, numpy arrays) is
+accepted.  Load .pt/.pth files with ``load_torch_file`` (requires torch).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+__all__ = ["import_torch_resnet", "load_torch_file"]
+
+# stage_sizes per depth, matching models/resnet.py factories
+_STAGES = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
+           101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
+_BOTTLENECK = {50, 101, 152}
+
+
+def _np(x) -> np.ndarray:
+    """torch.Tensor | np.ndarray -> float32 numpy (no torch import)."""
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().numpy()
+    return np.asarray(x, np.float32)
+
+
+def _conv(sd: Mapping, name: str) -> np.ndarray:
+    # torch conv weight OIHW -> flax HWIO
+    return _np(sd[f"{name}.weight"]).transpose(2, 3, 1, 0)
+
+
+def _bn(sd: Mapping, name: str):
+    params = {"scale": _np(sd[f"{name}.weight"]), "bias": _np(sd[f"{name}.bias"])}
+    stats = {"mean": _np(sd[f"{name}.running_mean"]),
+             "var": _np(sd[f"{name}.running_var"])}
+    return params, stats
+
+
+def import_torch_resnet(
+    state_dict: Mapping[str, Any], depth: int = 50
+) -> tuple[dict, dict]:
+    """Convert a torchvision-layout ResNet ``state_dict`` to
+    ``(params, model_state)`` for ``models.resnet{depth}``.
+
+    Returns trees ready for
+    ``model.apply({"params": params, **model_state}, x, train=False)``.
+    """
+    if depth not in _STAGES:
+        raise ValueError(f"unsupported depth {depth}; have {sorted(_STAGES)}")
+    stages = _STAGES[depth]
+    bottleneck = depth in _BOTTLENECK
+    block_name = "BottleneckBlock" if bottleneck else "BasicBlock"
+    nconvs = 3 if bottleneck else 2
+
+    params: dict = {}
+    stats: dict = {}
+
+    params["stem_conv"] = {"kernel": _conv(state_dict, "conv1")}
+    params["stem_bn"], stats["stem_bn"] = _bn(state_dict, "bn1")
+
+    k = 0  # flat block index, matching the compact-module naming order
+    for li, nblocks in enumerate(stages):
+        for bi in range(nblocks):
+            t = f"layer{li + 1}.{bi}"
+            f = f"{block_name}_{k}"
+            bp: dict = {}
+            bs: dict = {}
+            for ci in range(nconvs):
+                bp[f"Conv_{ci}"] = {"kernel": _conv(state_dict, f"{t}.conv{ci + 1}")}
+                bnp, bns = _bn(state_dict, f"{t}.bn{ci + 1}")
+                bp[f"BatchNorm_{ci}"] = bnp
+                bs[f"BatchNorm_{ci}"] = bns
+            if f"{t}.downsample.0.weight" in state_dict:
+                bp["downsample_conv"] = {"kernel": _conv(state_dict, f"{t}.downsample.0")}
+                bp["downsample_bn"], bs["downsample_bn"] = _bn(
+                    state_dict, f"{t}.downsample.1"
+                )
+            params[f] = bp
+            stats[f] = bs
+            k += 1
+
+    params["Dense_0"] = {
+        "kernel": _np(state_dict["fc.weight"]).T,
+        "bias": _np(state_dict["fc.bias"]),
+    }
+    return params, {"batch_stats": stats}
+
+
+def load_torch_file(path: str, depth: int = 50) -> tuple[dict, dict]:
+    """Load a .pt/.pth checkpoint file and convert (requires torch)."""
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(obj, dict) and "state_dict" in obj:
+        obj = obj["state_dict"]
+    return import_torch_resnet(obj, depth=depth)
