@@ -28,8 +28,12 @@ from typing import Optional, Sequence
 import numpy as np
 
 from .preprocess import preprocess, sample_augment_params
+from .sources import make_source
 
-__all__ = ["LabelTable", "SampleTable", "labels", "train_solutions", "makepaths", "ImageNetDataset"]
+__all__ = [
+    "LabelTable", "SampleTable", "labels", "train_solutions", "relpath",
+    "makepaths", "ImageNetDataset",
+]
 
 
 @dataclass
@@ -113,15 +117,19 @@ def train_solutions(
     return SampleTable(np.asarray(ids, object), np.asarray(cls, np.int32), split)
 
 
-def makepaths(image_id: str, root: str, split: str = "train") -> str:
-    """File layout (src/imagenet.jl:50-56): train images live under
-    ``ILSVRC/Data/CLS-LOC/train/<wnid>/<id>.JPEG`` (wnid prefix of the
-    id), val/test flat under their split dir."""
-    base = os.path.join(root, "ILSVRC", "Data", "CLS-LOC")
+def relpath(image_id: str, split: str = "train") -> str:
+    """Dataset-relative file layout (src/imagenet.jl:50-56): train images
+    live under ``ILSVRC/Data/CLS-LOC/train/<wnid>/<id>.JPEG`` (wnid
+    prefix of the id), val/test flat under their split dir."""
     if split == "train":
         wnid = image_id.split("_")[0]
-        return os.path.join(base, "train", wnid, f"{image_id}.JPEG")
-    return os.path.join(base, split, f"{image_id}.JPEG")
+        return f"ILSVRC/Data/CLS-LOC/train/{wnid}/{image_id}.JPEG"
+    return f"ILSVRC/Data/CLS-LOC/{split}/{image_id}.JPEG"
+
+
+def makepaths(image_id: str, root: str, split: str = "train") -> str:
+    """Absolute local path for a sample under a filesystem root."""
+    return os.path.join(root, relpath(image_id, split))
 
 
 class ImageNetDataset:
@@ -151,8 +159,22 @@ class ImageNetDataset:
         num_threads: int = 8,
         use_native: Optional[bool] = None,
         augment: Optional[bool] = None,
+        cache_dir: Optional[str] = None,
     ):
-        self.root = root
+        # ``root`` may be a local dir, a remote URL (gs:// or http(s)://,
+        # fetched through a caching source — the reference's S3-backed
+        # dataset analog, Data.toml:14-27), or a source object.
+        self.source = root if hasattr(root, "local_path") else make_source(
+            str(root), cache_dir=cache_dir
+        )
+        # the user-facing dataset location: a directory for filesystem
+        # sources, the gs://... or http(s)://... URL for remote ones
+        self.root = (
+            getattr(self.source, "root", None)
+            or getattr(self.source, "gs_url", None)
+            or getattr(self.source, "base_url", None)
+            or str(root)
+        )
         self.table = table
         self.nclasses = nclasses
         self.crop = crop
@@ -186,8 +208,27 @@ class ImageNetDataset:
     def __exit__(self, *exc):
         self.close()
 
+    def _ensure_pool(self) -> ThreadPoolExecutor:
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(max_workers=self._num_threads)
+        return self._pool
+
+    def _path(self, image_id: str) -> str:
+        """Local path of a sample (remote sources fetch-to-cache here, on
+        the decode worker thread — I/O overlaps other slots' decode)."""
+        return self.source.local_path(relpath(image_id, self.table.split))
+
+    def _paths(self, indices) -> list:
+        ids = [self.table.image_ids[j] for j in indices]
+        from .sources import FileSource
+
+        if isinstance(self.source, FileSource):
+            return [self._path(i) for i in ids]
+        # remote: fetch-to-cache concurrently, not one file at a time
+        return list(self._ensure_pool().map(self._path, ids))
+
     def _load_one(self, out: np.ndarray, i: int, image_id: str, aug=None):
-        path = makepaths(image_id, self.root, self.table.split)
+        path = self._path(image_id)
         out[i] = preprocess(
             path,
             crop=self.crop,
@@ -206,10 +247,7 @@ class ImageNetDataset:
         if self.use_native:
             from . import native as _native
 
-            paths = [
-                makepaths(self.table.image_ids[j], self.root, self.table.split)
-                for j in indices
-            ]
+            paths = self._paths(indices)
             # PIL fallback per file: ImageNet hides a few PNG/odd-format
             # files behind .JPEG extensions that libjpeg rejects.
             arr = _native.load_batch(
@@ -228,11 +266,10 @@ class ImageNetDataset:
                 ),
             )
             return arr, self.table.class_idx[indices]
-        if self._pool is None:
-            self._pool = ThreadPoolExecutor(max_workers=self._num_threads)
+        pool = self._ensure_pool()
         arr = np.zeros((len(indices), self.crop, self.crop, 3), np.float32)
         futures = [
-            self._pool.submit(
+            pool.submit(
                 self._load_one, arr, i, self.table.image_ids[j],
                 augs[i] if augs is not None else None,
             )

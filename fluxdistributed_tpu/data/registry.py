@@ -31,24 +31,27 @@ _REGISTRY: dict[str, dict] = {}
 
 def _driver_imagenet(spec: dict):
     from .imagenet import ImageNetDataset, labels, train_solutions
+    from .sources import make_source
 
-    root = spec["path"]
+    # ``path`` may be a local dir, gs://bucket/prefix, or http(s)://…
+    # (the reference's Data.toml registers both a FileSystem and an
+    # S3-backed driver for the same dataset, Data.toml:4-27); remote
+    # metadata files are fetched through the caching source.
+    source = make_source(str(spec["path"]), cache_dir=spec.get("cache_dir"))
     split = spec.get("split", "train")
-    lt = labels(spec.get("synset_mapping", os.path.join(root, "LOC_synset_mapping.txt")))
-    default_csv = os.path.join(root, f"LOC_{split}_solution.csv")
-    table = train_solutions(
-        spec.get("solution_csv", spec.get("train_solution", default_csv)),
-        lt,
-        classes=spec.get("classes"),
-        split=split,
-    )
+    synset = spec.get("synset_mapping") or source.local_path("LOC_synset_mapping.txt")
+    lt = labels(synset)
+    csv_path = spec.get("solution_csv", spec.get("train_solution"))
+    if csv_path is None:
+        csv_path = source.local_path(f"LOC_{split}_solution.csv")
+    table = train_solutions(csv_path, lt, classes=spec.get("classes"), split=split)
     kwargs = {}
     for k in ("augment", "use_native"):
         # None keeps the dataset's auto/per-split default
         if spec.get(k) is not None:
             kwargs[k] = bool(spec[k])
     return ImageNetDataset(
-        root,
+        source,
         table,
         nclasses=len(lt),
         crop=int(spec.get("crop", 224)),

@@ -1,0 +1,133 @@
+"""Bytes sources — local, HTTP, and GCS dataset backends with caching.
+
+The reference's ``Data.toml`` registers datasets on two storage drivers:
+a local ``FileSystem`` tree and a remote S3-backed ``JuliaHubDataRepo``
+(Data.toml:4-27); DataSets.jl hides the difference behind a BlobTree.
+The TPU-native analog (pods read from GCS in practice): a *source*
+object mapping dataset-relative paths to bytes, with remote sources
+caching fetched files locally so the hot path (native JPEG decode, which
+wants real file paths) is always a local read.
+
+* ``FileSource``  — a plain directory tree.
+* ``HTTPSource``  — ``http(s)://`` base URL + local cache.
+* ``GCSSource``   — ``gs://bucket/prefix`` via the public GCS HTTP
+  endpoint (``storage.googleapis.com``) — no cloud SDK dependency; for
+  private buckets set ``GCS_OAUTH_TOKEN`` (sent as a Bearer header).
+
+``make_source`` dispatches on the scheme, so every ``path`` in the
+dataset registry (data/registry.py) may be a local dir or a remote URL.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import urllib.parse
+import urllib.request
+
+__all__ = ["FileSource", "HTTPSource", "GCSSource", "make_source"]
+
+
+class FileSource:
+    """Local directory tree (the reference's FileSystem driver,
+    Data.toml:4-12)."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def local_path(self, rel: str) -> str:
+        """Path of ``rel`` on the local filesystem (no copy)."""
+        return os.path.join(self.root, rel)
+
+    def open_bytes(self, rel: str) -> bytes:
+        with open(self.local_path(rel), "rb") as f:
+            return f.read()
+
+    def __repr__(self):
+        return f"FileSource({self.root!r})"
+
+
+class HTTPSource:
+    """Remote tree behind a base URL, cached under ``cache_dir``.
+
+    ``local_path`` fetches on first access (atomic rename, so concurrent
+    decode threads never see partial files) and serves the cache
+    afterwards — the local-cache semantics DataSets.jl gives the
+    reference's S3 dataset.
+    """
+
+    def __init__(self, base_url: str, cache_dir: str | None = None, headers=None):
+        self.base_url = base_url.rstrip("/")
+        # Always namespace the cache by base URL — two datasets sharing a
+        # cache_dir must never serve each other's files (identical
+        # relative paths like LOC_synset_mapping.txt would collide).
+        key = urllib.parse.quote(self.base_url, safe="")
+        if cache_dir is None:
+            cache_dir = os.environ.get(
+                "FDTPU_CACHE", os.path.expanduser("~/.cache/fdtpu")
+            )
+        self.cache_dir = os.path.join(cache_dir, key)
+        self.headers = dict(headers or {})
+
+    def _request_headers(self) -> dict:
+        return self.headers
+
+    def _url(self, rel: str) -> str:
+        return f"{self.base_url}/{urllib.parse.quote(rel)}"
+
+    def open_bytes(self, rel: str) -> bytes:
+        req = urllib.request.Request(self._url(rel), headers=self._request_headers())
+        with urllib.request.urlopen(req) as r:
+            return r.read()
+
+    def local_path(self, rel: str) -> str:
+        dest = os.path.join(self.cache_dir, rel)
+        if os.path.exists(dest):
+            return dest
+        os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+        data = self.open_bytes(rel)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(dest), suffix=".part")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, dest)  # atomic: concurrent fetchers race benignly
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        return dest
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.base_url!r}, cache={self.cache_dir!r})"
+
+
+class GCSSource(HTTPSource):
+    """``gs://bucket/prefix`` via the public GCS JSON/XML HTTP endpoint."""
+
+    def __init__(self, gs_url: str, cache_dir: str | None = None):
+        parsed = urllib.parse.urlparse(gs_url)
+        if parsed.scheme != "gs" or not parsed.netloc:
+            raise ValueError(f"not a gs:// URL: {gs_url!r}")
+        base = f"https://storage.googleapis.com/{parsed.netloc}{parsed.path}"
+        super().__init__(base, cache_dir=cache_dir)
+        self.gs_url = gs_url
+
+    def _request_headers(self) -> dict:
+        # Re-read per request: OAuth tokens expire (~1h), and first-epoch
+        # fetch phases on large datasets run far longer than that — a
+        # refresher process can rotate GCS_OAUTH_TOKEN mid-run.
+        headers = dict(self.headers)
+        token = os.environ.get("GCS_OAUTH_TOKEN")
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        return headers
+
+
+def make_source(path_or_url: str, cache_dir: str | None = None):
+    """Dispatch a registry ``path`` to the right source by scheme."""
+    scheme = urllib.parse.urlparse(str(path_or_url)).scheme
+    if scheme == "gs":
+        return GCSSource(path_or_url, cache_dir=cache_dir)
+    if scheme in ("http", "https"):
+        return HTTPSource(path_or_url, cache_dir=cache_dir)
+    return FileSource(path_or_url)

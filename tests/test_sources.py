@@ -1,0 +1,116 @@
+"""Remote dataset sources: HTTP/gs:// drivers with local caching.
+
+The reference registers the same dataset on a filesystem driver AND a
+remote S3-backed driver (Data.toml:4-27).  These tests serve the
+miniature ILSVRC fixture tree over a real local HTTP server and exercise
+the full remote path: registry -> caching source -> metadata fetch ->
+batch assembly (native or PIL decode) -> cache hits with the server gone.
+"""
+
+from __future__ import annotations
+
+import http.server
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from fluxdistributed_tpu.data.sources import (
+    FileSource, GCSSource, HTTPSource, make_source,
+)
+
+from test_data import imagenet_root  # noqa: F401  (module-scoped fixture)
+
+
+@pytest.fixture()
+def http_root(imagenet_root):  # noqa: F811
+    """Serve the fixture tree over HTTP; yields (base_url, request_log)."""
+    requests: list[str] = []
+
+    class Handler(http.server.SimpleHTTPRequestHandler):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, directory=imagenet_root, **kw)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+        def do_GET(self):
+            requests.append(self.path)
+            super().do_GET()
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_address[1]}", requests
+    finally:
+        srv.shutdown()
+        t.join(timeout=5)
+
+
+def test_make_source_dispatch(tmp_path):
+    assert isinstance(make_source(str(tmp_path)), FileSource)
+    assert isinstance(make_source("http://x/y"), HTTPSource)
+    s = make_source("gs://bucket/prefix", cache_dir=str(tmp_path))
+    assert isinstance(s, GCSSource)
+    assert s.base_url == "https://storage.googleapis.com/bucket/prefix"
+    with pytest.raises(ValueError):
+        GCSSource("s3://nope")
+
+
+def test_http_source_fetch_and_cache(http_root, tmp_path):
+    base, requests = http_root
+    src = HTTPSource(base, cache_dir=str(tmp_path / "cache"))
+    p = src.local_path("LOC_synset_mapping.txt")
+    assert os.path.exists(p)
+    assert "tench" in open(p).read()
+    n = len(requests)
+    p2 = src.local_path("LOC_synset_mapping.txt")
+    assert p2 == p and len(requests) == n  # cache hit: no second request
+
+
+def test_registry_remote_imagenet_end_to_end(http_root, imagenet_root, tmp_path):  # noqa: F811
+    base, requests = http_root
+    from fluxdistributed_tpu.data.registry import open_dataset, register_dataset
+
+    register_dataset(
+        "imagenet_http_test",
+        "imagenet",
+        path=base,
+        cache_dir=str(tmp_path / "cache"),
+    )
+    ds = open_dataset("imagenet_http_test")
+    imgs, labels = ds.batch(np.random.default_rng(0), 6)
+    assert imgs.shape == (6, 224, 224, 3) and labels.shape == (6,)
+    assert any("CLS-LOC" in r for r in requests)  # images actually remote
+
+    # the identical draw through the filesystem driver must match exactly
+    register_dataset("imagenet_local_ref", "imagenet", path=imagenet_root)
+    ref = open_dataset("imagenet_local_ref")
+    ref_imgs, ref_labels = ref.batch(np.random.default_rng(0), 6)
+    np.testing.assert_array_equal(labels, ref_labels)
+    np.testing.assert_allclose(imgs, ref_imgs, atol=1e-6)
+
+
+def test_remote_cache_survives_server_shutdown(http_root, tmp_path):
+    base, requests = http_root
+    from fluxdistributed_tpu.data.registry import open_dataset, register_dataset
+    from fluxdistributed_tpu.data.sources import HTTPSource
+
+    register_dataset(
+        "imagenet_http_test2",
+        "imagenet",
+        path=base,
+        cache_dir=str(tmp_path / "cache"),
+    )
+    ds = open_dataset("imagenet_http_test2")
+    idx = np.arange(4)
+    first, _ = ds.batch(np.random.default_rng(1), 4, indices=idx)
+    assert isinstance(ds.source, HTTPSource) and ds.root == base
+    n_requests = len(requests)
+    # warm cache must fully cover these files: the same batch re-assembles
+    # bit-identically with no further HTTP traffic
+    second, _ = ds.batch(np.random.default_rng(1), 4, indices=idx)
+    np.testing.assert_array_equal(first, second)
+    assert len(requests) == n_requests
