@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Input-pipeline throughput benchmark: native C++ ingest vs PIL.
+
+The native loader's reason to exist is feeding the compiled train step
+(≥2,270 img/s/chip for ResNet-50 on v5e — see docs/benchmarks.md); this
+script measures what the host side can actually deliver: JPEG decode +
+resize-256/center-crop-224/normalize throughput for
+
+* the native C++ thread-pool pipeline (``native.load_batch``),
+* the PIL/numpy fallback path (``ImageNetDataset`` with
+  ``use_native=False``),
+
+across thread counts, on a generated fixture tree of ImageNet-sized
+JPEGs (500x375, the ILSVRC median).  The reference's analog is its
+threaded ``minibatch`` ingest (one Julia task per image,
+src/imagenet.jl:44-46), which it never measured either (SURVEY §6).
+
+Usage:  python benchmarks/input_pipeline.py [--images N] [--batch N]
+                                            [--threads 1,2,4,8]
+Prints a table plus one JSON line for regression tracking.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+
+def make_fixture(root: str, n_images: int, size=(500, 375)) -> list[str]:
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    paths = []
+    d = os.path.join(root, "imgs")
+    os.makedirs(d, exist_ok=True)
+    for i in range(n_images):
+        # low-frequency content so JPEG size/entropy is realistic-ish;
+        # kron up PAST the target then crop to exactly size
+        base = rng.normal(0, 1, (8, 8, 3))
+        arr = np.kron(base, np.ones((-(-size[1] // 8), -(-size[0] // 8), 1)))
+        arr = ((arr - arr.min()) / (np.ptp(arr) + 1e-9) * 255).astype(np.uint8)
+        arr = arr[: size[1], : size[0]]
+        assert arr.shape[:2] == (size[1], size[0]), arr.shape
+        p = os.path.join(d, f"img_{i:05d}.jpg")
+        Image.fromarray(arr).save(p, quality=85)
+        paths.append(p)
+    return paths
+
+
+def bench_native(paths, batch, threads, seconds=3.0):
+    from fluxdistributed_tpu.data import native
+
+    idx = np.random.default_rng(0).integers(0, len(paths), batch)
+    sel = [paths[i] for i in idx]
+    native.load_batch(sel, num_threads=threads)  # warmup
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < seconds:
+        native.load_batch(sel, num_threads=threads)
+        n += batch
+    return n / (time.perf_counter() - t0)
+
+
+def bench_pil(paths, batch, threads, seconds=3.0):
+    from concurrent.futures import ThreadPoolExecutor
+
+    from fluxdistributed_tpu.data.preprocess import preprocess
+
+    idx = np.random.default_rng(0).integers(0, len(paths), batch)
+    sel = [paths[i] for i in idx]
+    pool = ThreadPoolExecutor(max_workers=threads)
+
+    def run_once():
+        list(pool.map(preprocess, sel))
+
+    run_once()  # warmup
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < seconds:
+        run_once()
+        n += batch
+    rate = n / (time.perf_counter() - t0)
+    pool.shutdown(wait=False)
+    return rate
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=256)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--threads", default="1,2,4,8")
+    ap.add_argument("--seconds", type=float, default=3.0)
+    args = ap.parse_args()
+    threads = [int(t) for t in args.threads.split(",")]
+
+    from fluxdistributed_tpu.data import native
+
+    with tempfile.TemporaryDirectory() as root:
+        paths = make_fixture(root, args.images)
+        print(f"fixture: {len(paths)} JPEGs 500x375, batch {args.batch}, "
+              f"host cpus {os.cpu_count()}")
+        rows = []
+        for t in threads:
+            nat = bench_native(paths, args.batch, t, args.seconds) if native.available() else 0.0
+            pil = bench_pil(paths, args.batch, t, args.seconds)
+            rows.append((t, nat, pil))
+            ratio = f"{nat / pil:5.2f}x" if pil else "  n/a"
+            print(f"threads {t:2d}: native {nat:8.1f} img/s   PIL {pil:8.1f} img/s   {ratio}")
+
+        best_native = max(r[1] for r in rows)
+        best_pil = max(r[2] for r in rows)
+        print(json.dumps({
+            "metric": "input-pipeline decode+preprocess throughput",
+            "unit": "images/sec",
+            "native_best": round(best_native, 1),
+            "pil_best": round(best_pil, 1),
+            "host_cpus": os.cpu_count(),
+            "threads": threads,
+        }))
+
+
+if __name__ == "__main__":
+    main()

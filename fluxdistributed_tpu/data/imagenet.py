@@ -169,12 +169,7 @@ class ImageNetDataset:
         )
         # the user-facing dataset location: a directory for filesystem
         # sources, the gs://... or http(s)://... URL for remote ones
-        self.root = (
-            getattr(self.source, "root", None)
-            or getattr(self.source, "gs_url", None)
-            or getattr(self.source, "base_url", None)
-            or str(root)
-        )
+        self.root = getattr(self.source, "location", str(root))
         self.table = table
         self.nclasses = nclasses
         self.crop = crop
@@ -214,15 +209,19 @@ class ImageNetDataset:
         return self._pool
 
     def _path(self, image_id: str) -> str:
-        """Local path of a sample (remote sources fetch-to-cache here, on
-        the decode worker thread — I/O overlaps other slots' decode)."""
+        """Local path of a sample (remote sources fetch-to-cache here).
+
+        On the PIL path this runs on the decode worker, so fetch I/O
+        overlaps other slots' decode; on the native path ``_paths``
+        fetches the whole batch concurrently *before* handing local
+        files to the C++ pool (cold-cache batches pay fetch-then-decode
+        as two phases — steady-state cache hits make it a pure local
+        read)."""
         return self.source.local_path(relpath(image_id, self.table.split))
 
     def _paths(self, indices) -> list:
         ids = [self.table.image_ids[j] for j in indices]
-        from .sources import FileSource
-
-        if isinstance(self.source, FileSource):
+        if getattr(self.source, "is_local", True):
             return [self._path(i) for i in ids]
         # remote: fetch-to-cache concurrently, not one file at a time
         return list(self._ensure_pool().map(self._path, ids))

@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import os
 import tempfile
+import time
+import urllib.error
 import urllib.parse
 import urllib.request
 
@@ -32,8 +34,15 @@ class FileSource:
     """Local directory tree (the reference's FileSystem driver,
     Data.toml:4-12)."""
 
+    is_local = True
+
     def __init__(self, root: str):
         self.root = root
+
+    @property
+    def location(self) -> str:
+        """User-facing dataset location (directory or URL)."""
+        return self.root
 
     def local_path(self, rel: str) -> str:
         """Path of ``rel`` on the local filesystem (no copy)."""
@@ -56,6 +65,8 @@ class HTTPSource:
     reference's S3 dataset.
     """
 
+    is_local = False
+
     def __init__(self, base_url: str, cache_dir: str | None = None, headers=None):
         self.base_url = base_url.rstrip("/")
         # Always namespace the cache by base URL — two datasets sharing a
@@ -72,13 +83,38 @@ class HTTPSource:
     def _request_headers(self) -> dict:
         return self.headers
 
+    @property
+    def location(self) -> str:
+        return self.base_url
+
     def _url(self, rel: str) -> str:
         return f"{self.base_url}/{urllib.parse.quote(rel)}"
 
+    #: request timeout (s) and transient-status retry schedule — object
+    #: storage at pod request rates throws occasional 429/5xx and expects
+    #: exponential backoff; a stalled connection must not wedge a decode
+    #: worker forever.
+    timeout = 30.0
+    retry_backoff = (1.0, 2.0, 4.0)
+
     def open_bytes(self, rel: str) -> bytes:
-        req = urllib.request.Request(self._url(rel), headers=self._request_headers())
-        with urllib.request.urlopen(req) as r:
-            return r.read()
+        last: Exception | None = None
+        for i in range(len(self.retry_backoff) + 1):
+            req = urllib.request.Request(
+                self._url(rel), headers=self._request_headers()
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    return r.read()
+            except urllib.error.HTTPError as e:
+                if e.code not in (429, 500, 502, 503, 504):
+                    raise
+                last = e
+            except (urllib.error.URLError, TimeoutError, OSError) as e:
+                last = e
+            if i < len(self.retry_backoff):
+                time.sleep(self.retry_backoff[i])
+        raise last  # type: ignore[misc]
 
     def local_path(self, rel: str) -> str:
         dest = os.path.join(self.cache_dir, rel)
@@ -111,6 +147,10 @@ class GCSSource(HTTPSource):
         base = f"https://storage.googleapis.com/{parsed.netloc}{parsed.path}"
         super().__init__(base, cache_dir=cache_dir)
         self.gs_url = gs_url
+
+    @property
+    def location(self) -> str:
+        return self.gs_url
 
     def _request_headers(self) -> dict:
         # Re-read per request: OAuth tokens expire (~1h), and first-epoch
