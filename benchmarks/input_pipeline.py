@@ -104,20 +104,24 @@ def main():
         paths = make_fixture(root, args.images)
         print(f"fixture: {len(paths)} JPEGs 500x375, batch {args.batch}, "
               f"host cpus {os.cpu_count()}")
+        has_native = native.available()
+        if not has_native:
+            print("native loader unavailable (g++/libjpeg missing) — PIL only")
         rows = []
         for t in threads:
-            nat = bench_native(paths, args.batch, t, args.seconds) if native.available() else 0.0
+            nat = bench_native(paths, args.batch, t, args.seconds) if has_native else None
             pil = bench_pil(paths, args.batch, t, args.seconds)
             rows.append((t, nat, pil))
-            ratio = f"{nat / pil:5.2f}x" if pil else "  n/a"
-            print(f"threads {t:2d}: native {nat:8.1f} img/s   PIL {pil:8.1f} img/s   {ratio}")
+            nat_s = f"{nat:8.1f}" if nat is not None else "     n/a"
+            ratio = f"{nat / pil:5.2f}x" if (nat and pil) else "  n/a"
+            print(f"threads {t:2d}: native {nat_s} img/s   PIL {pil:8.1f} img/s   {ratio}")
 
-        best_native = max(r[1] for r in rows)
+        best_native = max((r[1] for r in rows if r[1]), default=None)
         best_pil = max(r[2] for r in rows)
         print(json.dumps({
             "metric": "input-pipeline decode+preprocess throughput",
             "unit": "images/sec",
-            "native_best": round(best_native, 1),
+            "native_best": round(best_native, 1) if best_native else None,
             "pil_best": round(best_pil, 1),
             "host_cpus": os.cpu_count(),
             "threads": threads,

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Interactive inference demo — the webcam notebook, TPU-native.
+
+The reference's Pluto notebook embeds an HTML/JS webcam widget
+(bin/pluto.jl:133-334) and classifies captured frames with a trained
+model (:338-382).  The analog here is a tiny stdlib HTTP server:
+
+* ``GET /``        — a self-contained HTML page that opens the webcam
+                     (``getUserMedia``), draws frames to a canvas, and
+                     POSTs JPEG snapshots to ``/predict``;
+* ``POST /predict``— decode → preprocess (the training pipeline's
+                     resize-256/center-crop-224/normalize) → one jitted
+                     forward pass → JSON top-k labels.
+
+    python bin/serve.py --model resnet50 --torch-weights r50.pt \
+        --synset LOC_synset_mapping.txt --port 8000
+
+Then open http://localhost:8000 in a browser.  Works with trainer
+checkpoints (``--checkpoint``), torchvision-layout weights
+(``--torch-weights``), or random init (demo mode).
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import sys
+
+HTML = """<!doctype html>
+<html><head><title>fluxdistributed_tpu live inference</title><style>
+ body{font-family:sans-serif;max-width:720px;margin:2em auto}
+ video,canvas{width:320px;height:240px;background:#222;border-radius:8px}
+ table{border-collapse:collapse;margin-top:1em}
+ td,th{padding:4px 12px;border-bottom:1px solid #ccc;text-align:left}
+</style></head><body>
+<h2>Live inference</h2>
+<p>Frames are captured from your camera and classified server-side.</p>
+<video id="v" autoplay playsinline muted></video>
+<canvas id="c" width="320" height="240" style="display:none"></canvas>
+<p><button id="go">start</button> <span id="status"></span></p>
+<table id="preds"><thead><tr><th>#</th><th>class</th><th>p</th></tr></thead>
+<tbody></tbody></table>
+<script>
+const v=document.getElementById('v'),c=document.getElementById('c'),
+      ctx=c.getContext('2d'),tb=document.querySelector('#preds tbody'),
+      st=document.getElementById('status');
+let running=false;
+async function tick(){
+  if(!running) return;
+  ctx.drawImage(v,0,0,c.width,c.height);
+  const blob=await new Promise(r=>c.toBlob(r,'image/jpeg',0.8));
+  try{
+    const resp=await fetch('/predict',{method:'POST',body:blob});
+    const data=await resp.json();
+    tb.innerHTML=data.predictions.map((p,i)=>
+      `<tr><td>${i+1}</td><td>${p.label}</td><td>${p.prob.toFixed(3)}</td></tr>`).join('');
+    st.textContent=`${data.ms.toFixed(0)} ms/frame`;
+  }catch(e){st.textContent=e; running=false;}
+  setTimeout(tick,250);
+}
+document.getElementById('go').onclick=async()=>{
+  if(running){running=false;return;}
+  const s=await navigator.mediaDevices.getUserMedia({video:true});
+  v.srcObject=s; running=true; tick();
+};
+</script></body></html>"""
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--model", default="resnet50")
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--torch-weights", default=None)
+    p.add_argument("--synset", default=None)
+    p.add_argument("--topk", type=int, default=3)
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--platform", default=None)
+    return p
+
+
+def make_app(args):
+    """Build (predict_fn, class_names); separate from serving for tests."""
+    import jax
+    import numpy as np
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from fluxdistributed_tpu import models as models_lib
+    from fluxdistributed_tpu.data.preprocess import preprocess
+
+    factory = getattr(models_lib, args.model)
+    model = factory(num_classes=args.num_classes)
+    dummy = np.zeros((1, 224, 224, 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(0), dummy, train=False)
+    if args.torch_weights:
+        from fluxdistributed_tpu.models.torch_import import load_torch_file
+
+        params, mstate = load_torch_file(
+            args.torch_weights, depth=int(args.model[6:])
+        )
+        variables = {"params": params, **mstate}
+    elif args.checkpoint:
+        from fluxdistributed_tpu.train.checkpoint import load_checkpoint
+
+        restored = load_checkpoint(args.checkpoint)
+        variables = {"params": restored["params"], **restored.get("model_state", {})}
+
+    names = None
+    if args.synset:
+        from fluxdistributed_tpu.data.imagenet import labels
+
+        names = [n.split(",")[0] for n in labels(args.synset).names]
+
+    fwd = jax.jit(lambda v, x: model.apply(v, x, train=False))
+    fwd(variables, dummy)  # compile before the first request
+
+    def predict(jpeg_bytes: bytes):
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(jpeg_bytes)).convert("RGB")
+        x = preprocess(np.asarray(img, np.uint8))[None]
+        logits = np.asarray(fwd(variables, x))[0]
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        top = np.argsort(-p)[: args.topk]
+        return [
+            {"label": names[i] if names else f"class {i}", "prob": float(p[i])}
+            for i in top
+        ]
+
+    return predict
+
+
+def serve(args, predict):
+    import http.server
+    import time
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code, body: bytes, ctype: str):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path in ("/", "/index.html"):
+                self._send(200, HTML.encode(), "text/html")
+            else:
+                self._send(404, b"not found", "text/plain")
+
+        def do_POST(self):
+            if self.path != "/predict":
+                self._send(404, b"not found", "text/plain")
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            data = self.rfile.read(n)
+            t0 = time.perf_counter()
+            try:
+                preds = predict(data)
+            except Exception as e:  # bad frame: report, don't die
+                self._send(400, json.dumps({"error": str(e)}).encode(),
+                           "application/json")
+                return
+            body = json.dumps({
+                "predictions": preds,
+                "ms": (time.perf_counter() - t0) * 1e3,
+            }).encode()
+            self._send(200, body, "application/json")
+
+    srv = http.server.ThreadingHTTPServer((args.host, args.port), Handler)
+    print(f"serving on http://{args.host}:{srv.server_address[1]}/ (ctrl-c to stop)")
+    return srv
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    predict = make_app(args)
+    srv = serve(args, predict)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

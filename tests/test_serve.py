@@ -1,0 +1,79 @@
+"""Interactive inference server (bin/serve.py) — the webcam-demo analog.
+
+Covers the reference's Pluto demo behaviors (bin/pluto.jl): serve the
+capture page (:133-334), classify a posted frame, return top-k labels
+with probabilities (:338-382).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pathlib
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1] / "bin"))
+
+import serve  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def server():
+    args = serve.build_parser().parse_args(
+        ["--model", "resnet18", "--num-classes", "10", "--topk", "3",
+         "--port", "0"]
+    )
+    predict = serve.make_app(args)
+    srv = serve.serve(args, predict)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_address[1]}"
+    finally:
+        srv.shutdown()
+        t.join(timeout=5)
+
+
+def _jpeg_bytes() -> bytes:
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    arr = rng.integers(0, 255, (240, 320, 3), dtype=np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def test_index_page(server):
+    with urllib.request.urlopen(f"{server}/") as r:
+        body = r.read().decode()
+    assert "getUserMedia" in body and "/predict" in body
+
+
+def test_predict_roundtrip(server):
+    req = urllib.request.Request(f"{server}/predict", data=_jpeg_bytes(), method="POST")
+    with urllib.request.urlopen(req) as r:
+        data = json.loads(r.read())
+    preds = data["predictions"]
+    assert len(preds) == 3
+    assert all(0.0 <= p["prob"] <= 1.0 for p in preds)
+    assert data["ms"] > 0
+
+
+def test_predict_bad_payload(server):
+    req = urllib.request.Request(f"{server}/predict", data=b"not a jpeg", method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 400
+    assert "error" in json.loads(ei.value.read())
+
+
+def test_unknown_path_404(server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"{server}/nope")
+    assert ei.value.code == 404
