@@ -1,5 +1,6 @@
-"""Expert parallelism (MoE): switch-style top-1 routing with capacity,
-experts sharded one-per-device over an ``expert`` mesh axis.
+"""Expert parallelism (MoE): top-1 (Switch) or top-k (GShard-style)
+routing with capacity, experts sharded over an ``expert`` mesh axis
+(``E // axis_size`` experts hosted per device, batched with ``vmap``).
 
 Net-new scope beyond the reference (SURVEY §2: "EP: NO"), built the
 TPU-classic way (Mesh-TF/Switch lineage): tokens are sharded over the
@@ -9,13 +10,17 @@ token activations to their expert's device and back — dense einsums and
 static shapes throughout, so XLA keeps everything on the MXU (no
 gather/scatter in the hot path).
 
-Semantics (Switch Transformer):
-* top-1 expert per token, output scaled by the router probability;
+Semantics:
+* ``top_k=1`` (Switch): one expert per token, output scaled by the
+  router probability; ``top_k>1`` (GShard lineage): k experts per
+  token, later choices queue after earlier ones in each expert's
+  capacity, gates normalized to sum to 1;
 * per-shard expert capacity ``C = ceil(tokens_per_shard / E *
-  capacity_factor)``; tokens over capacity are DROPPED (output zero) —
-  the documented switch behavior;
-* auxiliary load-balance loss ``E * Σ_e f_e · p_e`` (fraction routed ×
-  mean router prob), returned for the caller to add to the task loss.
+  capacity_factor * top_k)``; tokens over capacity are DROPPED (output
+  zero for that choice) — the documented switch behavior;
+* auxiliary load-balance loss ``E * Σ_e f_e · p_e`` (first-choice
+  fraction routed × mean router prob), returned for the caller to add
+  to the task loss.
 """
 
 from __future__ import annotations
@@ -37,37 +42,68 @@ EXPERT_AXIS = "expert"
 
 def stack_expert_params(per_expert: list, mesh: Mesh, axis: str = EXPERT_AXIS) -> Pytree:
     """Stack E per-expert param trees on a leading dim sharded over
-    ``axis`` — expert e's params live on expert-device e."""
+    ``axis`` — expert ``g`` lives on device ``g // (E // axis_size)``
+    (contiguous blocks of local experts per device)."""
     from ..sharding import stack_on_axis
 
     return stack_on_axis(per_expert, mesh, axis)
 
 
-def router_dispatch(logits: jnp.ndarray, capacity: int):
-    """Top-1 dispatch/combine tensors from router logits.
+def router_dispatch(
+    logits: jnp.ndarray, capacity: int, k: int = 1, normalize: Optional[bool] = None
+):
+    """Top-``k`` dispatch/combine tensors from router logits.
 
     ``logits``: (T, E).  Returns ``dispatch`` (T, E, C) {0,1},
-    ``combine`` (T, E, C) = dispatch · router prob, and the switch
-    load-balance auxiliary loss.  Pure jnp — used identically inside the
-    sharded program and by the single-device golden model in tests.
+    ``combine`` (T, E, C) = dispatch · gate, and the load-balance
+    auxiliary loss.  Pure jnp — used identically inside the sharded
+    program and by the single-device golden model in tests.
+
+    ``k=1`` is Switch routing (gate = router prob); ``k>1`` is
+    GShard-style top-k, where later choices queue after earlier ones in
+    each expert's capacity and gates are normalized to sum to 1 across
+    the chosen experts (``normalize`` overrides; default ``k > 1``).
+    The aux loss always uses first-choice assignment (Switch def.).
     """
     t, e = logits.shape
     dtype = logits.dtype
+    if not 1 <= k <= e:
+        # past round E the masked probs are all-zero and argmax would
+        # silently re-route every token to expert 0
+        raise ValueError(f"top-k ({k}) must be in [1, experts ({e})]")
+    if normalize is None:
+        normalize = k > 1
     # routing math in f32 regardless of compute dtype: a bf16 cumsum
     # saturates at 256, collapsing every later queue position onto slot
     # 255 (silent dispatch corruption for large expert queues)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)  # (T,)
-    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (T, E)
-    # position of each token in its expert's queue (0-based)
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0
-    kept = (pos >= 0) & (pos < capacity)
-    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
-    dispatch = (pos_oh * kept.astype(jnp.float32)[..., None]).astype(dtype)
-    gate = jnp.max(probs * onehot, axis=-1)  # (T,) routed prob, f32
-    combine = (dispatch.astype(jnp.float32) * gate[:, None, None]).astype(dtype)
+    masked = probs
+    counts = jnp.zeros((e,), jnp.float32)  # queue fill from earlier rounds
+    ds, gates = [], []
+    first_oh = None
+    for _ in range(k):
+        expert_idx = jnp.argmax(masked, axis=-1)  # (T,)
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (T, E)
+        if first_oh is None:
+            first_oh = onehot
+        # position of each token in its expert's queue (0-based), offset
+        # by tokens already queued there in earlier rounds
+        pos = (jnp.cumsum(onehot, axis=0) + counts[None, :]) * onehot - 1.0
+        kept = (pos >= 0) & (pos < capacity)
+        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+        ds.append(pos_oh * kept.astype(jnp.float32)[..., None])
+        gates.append(jnp.max(probs * onehot, axis=-1))  # (T,) routed prob, f32
+        counts = counts + onehot.sum(axis=0)
+        masked = masked * (1.0 - onehot)
+    if normalize:
+        gsum = sum(gates) + 1e-9
+        gates = [g / gsum for g in gates]
+    dispatch = sum(ds).astype(dtype)
+    combine = sum(
+        d * g[:, None, None] for d, g in zip(ds, gates)
+    ).astype(dtype)
     # load-balance aux: E * Σ_e (fraction of tokens to e) · (mean prob of e)
-    frac = onehot.mean(axis=0)
+    frac = first_oh.mean(axis=0)
     mean_p = probs.mean(axis=0)
     aux = e * jnp.sum(frac * mean_p)
     return dispatch, combine, aux
@@ -79,14 +115,18 @@ def moe_apply(
     axis: str = EXPERT_AXIS,
     capacity_factor: float = 1.25,
     capacity: Optional[int] = None,
+    top_k: int = 1,
 ):
     """Build ``fn(stacked_params, router_w, x) -> (y, aux)``.
 
     ``x``: (T, D) tokens sharded on ``axis``; ``router_w``: (D, E)
     replicated; ``stacked_params`` leaves (E, ...) sharded on ``axis``.
-    E must equal the ``axis`` size (one expert per device).  Output is
+    E must be a multiple of the ``axis`` size: each device hosts
+    ``E // axis_size`` experts (expert ``g`` lives on device ``g // L``,
+    matching ``stack_expert_params``'s contiguous sharding).  Output is
     token-sharded like ``x``; ``aux`` is the replicated (pmean-ed)
-    load-balance loss.
+    load-balance loss.  ``top_k`` selects Switch (1) or GShard-style
+    top-k routing.
     """
     e_devices = mesh.shape[axis]
 
@@ -97,29 +137,36 @@ def moe_apply(
         out_specs=(P(axis), P()),
     )
     def run(stacked_params, router_w, x):
-        params = jax.tree.map(lambda p: p[0], stacked_params)  # my expert
         t, d = x.shape
         e = router_w.shape[-1]
-        assert e == e_devices, f"experts ({e}) must equal '{axis}' size ({e_devices})"
+        s = e_devices  # shards on the expert axis
+        assert e % s == 0, (
+            f"experts ({e}) must be a multiple of '{axis}' size ({s})"
+        )
+        loc = e // s  # experts hosted per device
         if capacity is not None:
             if capacity < 1:
                 raise ValueError(f"capacity must be >= 1, got {capacity}")
             cap = capacity
         else:
-            cap = max(1, math.ceil(t / e * capacity_factor))
+            cap = max(1, math.ceil(t / e * capacity_factor * top_k))
         logits = x @ router_w
-        dispatch, combine, aux = router_dispatch(logits, cap)
+        dispatch, combine, aux = router_dispatch(logits, cap, k=top_k)
         # (T,D),(T,E,C) → (E,C,D): each expert's queue from this shard
         expert_in = jnp.einsum("td,tec->ecd", x, dispatch)
-        # exchange: device e receives every shard's queue for expert e
+        # exchange: device q receives every shard's queues for its LOC
+        # local experts (global expert g = q·LOC + l)
         expert_in = jax.lax.all_to_all(
-            expert_in, axis, split_axis=0, concat_axis=0, tiled=False
-        )  # (S, C, D) with S = number of shards
-        s = expert_in.shape[0]
-        y = expert_fn(params, expert_in.reshape(s * cap, d)).reshape(s, cap, d)
+            expert_in.reshape(s, loc, cap, d), axis,
+            split_axis=0, concat_axis=0, tiled=False,
+        )  # (S_src, LOC, C, D)
+        # per local expert: tokens from all shards, one batched apply
+        xin = expert_in.transpose(1, 0, 2, 3).reshape(loc, s * cap, d)
+        y = jax.vmap(expert_fn)(stacked_params, xin)  # leaves (LOC, ...)
+        y = y.reshape(loc, s, cap, d).transpose(1, 0, 2, 3)  # (S, LOC, C, D)
         # route results back to the token-owning shards
         y = jax.lax.all_to_all(y, axis, split_axis=0, concat_axis=0, tiled=False)
-        out = jnp.einsum("ecd,tec->td", y, combine)
+        out = jnp.einsum("ecd,tec->td", y.reshape(e, cap, d), combine)
         return out, jax.lax.pmean(aux, axis)
 
     return run

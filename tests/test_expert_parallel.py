@@ -48,10 +48,10 @@ def setup(mesh):
     return per_expert, router_w, x
 
 
-def golden_moe(per_expert, router_w, x_shard, capacity):
+def golden_moe(per_expert, router_w, x_shard, capacity, k=1):
     """Dense single-shard reference with the same routing math."""
     logits = x_shard @ router_w
-    dispatch, combine, aux = router_dispatch(logits, capacity)
+    dispatch, combine, aux = router_dispatch(logits, capacity, k=k)
     expert_in = jnp.einsum("td,tec->ecd", x_shard, dispatch)  # (E, C, D)
     y = jnp.stack([expert_fn(p, expert_in[e]) for e, p in enumerate(per_expert)])
     out = jnp.einsum("ecd,tec->td", y, combine)
@@ -78,6 +78,64 @@ def test_moe_matches_golden_model(setup, mesh):
     want = np.concatenate(outs)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(float(aux), np.mean(auxes), rtol=1e-5)
+
+
+def test_moe_multiple_experts_per_device(mesh):
+    """E = 2× devices: each device hosts two experts, still matches the
+    dense golden model."""
+    import math
+
+    e_total = 2 * E
+    keys = jax.random.split(jax.random.PRNGKey(7), e_total)
+    per_expert = [_expert_params(k) for k in keys]
+    router_w = jax.random.normal(jax.random.PRNGKey(8), (D, e_total), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(9), (T, D), jnp.float32)
+
+    t_shard = T // E
+    cap = max(1, math.ceil(t_shard / e_total * 2.0))
+    fn = moe_apply(expert_fn, mesh, capacity_factor=2.0)
+    stacked = stack_expert_params(per_expert, mesh)
+    got, aux = fn(stacked, router_w, x)
+    got = np.asarray(got)
+
+    outs, auxes = [], []
+    for s in range(E):
+        o, a = golden_moe(per_expert, router_w, x[s * t_shard : (s + 1) * t_shard], cap)
+        outs.append(np.asarray(o))
+        auxes.append(float(a))
+    np.testing.assert_allclose(got, np.concatenate(outs), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(aux), np.mean(auxes), rtol=1e-5)
+
+
+def test_moe_top2_matches_golden(mesh, setup):
+    """GShard-style top-2 routing matches the dense golden model."""
+    import math
+
+    per_expert, router_w, x = setup
+    t_shard = T // E
+    cap = max(1, math.ceil(t_shard / E * 1.25 * 2))
+    fn = moe_apply(expert_fn, mesh, capacity_factor=1.25, top_k=2)
+    stacked = stack_expert_params(per_expert, mesh)
+    got, aux = fn(stacked, router_w, x)
+    got = np.asarray(got)
+
+    outs = []
+    for s in range(E):
+        o, _ = golden_moe(
+            per_expert, router_w, x[s * t_shard : (s + 1) * t_shard], cap, k=2
+        )
+        outs.append(np.asarray(o))
+    np.testing.assert_allclose(got, np.concatenate(outs), rtol=1e-5, atol=1e-5)
+
+
+def test_top2_gates_normalized():
+    """Top-2 combine weights for a kept token sum to ~1."""
+    logits = jnp.asarray(np.random.default_rng(0).normal(0, 1, (16, 4)), jnp.float32)
+    dispatch, combine, _ = router_dispatch(logits, capacity=16, k=2)
+    d = np.asarray(dispatch)
+    c = np.asarray(combine)
+    assert np.allclose(d.sum(axis=(1, 2)), 2.0)  # both choices kept
+    np.testing.assert_allclose(c.sum(axis=(1, 2)), 1.0, rtol=1e-5)
 
 
 def test_capacity_drops_overflow_tokens(mesh, setup):
