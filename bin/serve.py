@@ -98,17 +98,19 @@ def make_app(args):
         raise SystemExit(f"unknown model {args.model!r}")
     if args.torch_weights and args.checkpoint:
         raise SystemExit("--torch-weights and --checkpoint are mutually exclusive")
+    if args.torch_weights and (
+        not args.model.startswith("resnet") or not args.model[6:].isdigit()
+    ):
+        raise SystemExit(
+            "--torch-weights requires a resnet model "
+            f"(resnet18/34/50/101/152), got {args.model!r}"
+        )
     model = factory(num_classes=args.num_classes)
     dummy = np.zeros((1, 224, 224, 3), np.float32)
     variables = model.init(jax.random.PRNGKey(0), dummy, train=False)
     if args.torch_weights:
         from fluxdistributed_tpu.models.torch_import import load_torch_file
 
-        if not args.model.startswith("resnet") or not args.model[6:].isdigit():
-            raise SystemExit(
-                "--torch-weights requires a resnet model "
-                f"(resnet18/34/50/101/152), got {args.model!r}"
-            )
         params, mstate = load_torch_file(
             args.torch_weights, depth=int(args.model[6:])
         )

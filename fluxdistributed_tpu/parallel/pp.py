@@ -10,11 +10,18 @@ is not hand-written: differentiating through ``scan`` + ``ppermute``
 yields the reverse pipeline schedule automatically (the transpose of a
 ``ppermute`` is the reverse permutation).
 
-Model contract (the homogeneous-pipeline form): one ``stage_fn(params,
-x) -> y`` applied on every pipe device with that device's slice of the
-stacked stage parameters; activations keep one shape across stages (the
-``d_model`` residual-stream invariant transformers already satisfy).
-Heterogeneous embed/head layers compose outside the pipelined middle.
+Model contract: one ``stage_fn(params, x) -> y`` applied on every pipe
+device with that device's slice of the stacked stage parameters;
+activations keep one shape across stages (the ``d_model``
+residual-stream invariant transformers already satisfy).  Stages may be
+*heterogeneous in behavior*: a ``stage_fn(params, x, stage) -> y``
+signature receives the stage index (a traced scalar) and may
+``lax.switch`` on it — ``switch_stage([f0, f1, ...])`` builds exactly
+that from per-stage callables.  Parameters stay structurally identical
+across stages: give every stage the superset parameter tree (unused
+leaves still occupy their stage's memory, so keep supersets lean).
+Embed/head layers that change the activation shape compose outside the
+pipelined middle.
 
 Schedule shape: M microbatches through S stages take M + S - 1 ticks;
 the (S-1)/(M+S-1) bubble shrinks as M grows — pick ``num_microbatches >=
@@ -35,9 +42,53 @@ from .dp import TrainState
 
 Pytree = Any
 
-__all__ = ["pipeline_apply", "make_train_step_pp", "stack_stage_params"]
+__all__ = ["pipeline_apply", "make_train_step_pp", "stack_stage_params", "switch_stage"]
 
 PIPE_AXIS = "pipe"
+
+
+def _accepts_stage(fn: Callable) -> bool:
+    """Does ``fn`` require a third positional arg (the stage index)?
+
+    Deliberately strict: only callables with >= 3 *non-defaulted*
+    positional parameters opt in.  A defaulted third parameter
+    (``def f(p, x, scale=0.5)``) or ``*args`` must NOT silently receive
+    the traced stage index — that would corrupt previously-valid
+    two-argument stage functions.  ``switch_stage`` is the explicit
+    opt-in for heterogeneous pipelines.
+    """
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    required = [
+        p for p in sig.parameters.values()
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+        and p.default is p.empty
+    ]
+    return len(required) >= 3
+
+
+def switch_stage(stage_fns: list) -> Callable:
+    """Compose per-stage callables into one ``stage_fn(params, x, stage)``
+    that ``lax.switch``es on the (traced) stage index — the heterogeneous
+    pipeline form.  Every callable must accept the same params structure
+    (use a superset tree) and preserve the activation shape.
+
+    The callable records ``len(stage_fns)`` so ``pipeline_apply`` can
+    reject a list whose length does not match the pipeline's stage count
+    (``lax.switch`` clamps out-of-range indices, which would otherwise
+    silently reuse the last stage)."""
+
+    branches = [lambda p, x, f=f: f(p, x) for f in stage_fns]
+
+    def fn(params, x, stage):
+        return jax.lax.switch(stage, branches, params, x)
+
+    fn._num_stage_fns = len(stage_fns)
+    return fn
 
 
 def stack_stage_params(per_stage: list, mesh: Mesh, axis: str = PIPE_AXIS) -> Pytree:
@@ -64,6 +115,13 @@ def pipeline_apply(
     S = mesh.shape[axis]
     M = num_microbatches or S
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    with_stage = _accepts_stage(stage_fn)
+    n_fns = getattr(stage_fn, "_num_stage_fns", None)
+    if n_fns is not None and n_fns != S:
+        raise ValueError(
+            f"switch_stage got {n_fns} stage fns but the '{axis}' axis has "
+            f"{S} stages (lax.switch would silently clamp the stage index)"
+        )
 
     @partial(
         jax.shard_map,
@@ -90,7 +148,7 @@ def pipeline_apply(
                 mb, jnp.minimum(t, M - 1), 0, keepdims=False
             )
             x_in = jnp.where(idx == 0, jnp.where(t < M, feed, zero), state)
-            y = stage_fn(params, x_in)
+            y = stage_fn(params, x_in, idx) if with_stage else stage_fn(params, x_in)
             # the last stage's result for microbatch t-(S-1) is ready
             out = jnp.where(idx == S - 1, y, jnp.zeros_like(y))
             state_next = jax.lax.ppermute(y, axis, fwd_perm)

@@ -109,3 +109,89 @@ def test_pp_train_step_loss_falls(mesh):
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0] * 0.6, losses[::8]
     assert int(state.step) == 25
+
+
+# ---- heterogeneous stages (stage_fn(params, x, stage) + switch_stage) ----
+
+def test_heterogeneous_pipeline_matches_sequential(mesh, per_stage):
+    """Alternating gelu/tanh stages via switch_stage match sequential."""
+    from fluxdistributed_tpu.parallel.pp import switch_stage
+
+    def gelu_stage(p, x):
+        return x + jax.nn.gelu(x @ p["w"] + p["b"])
+
+    def tanh_stage(p, x):
+        return x + jnp.tanh(x @ p["w"] + p["b"])
+
+    fns = [gelu_stage if s % 2 == 0 else tanh_stage for s in range(S)]
+    het = switch_stage(fns)
+
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, D), jnp.float32)
+    stacked = stack_stage_params(per_stage, mesh)
+    fwd = pipeline_apply(het, mesh, num_microbatches=4)
+    got = np.asarray(jax.jit(fwd)(stacked, x))
+
+    want = x
+    for s, p in enumerate(per_stage):
+        want = fns[s](p, want)
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_heterogeneous_pipeline_grads_match_sequential(mesh, per_stage):
+    from fluxdistributed_tpu.parallel.pp import switch_stage
+
+    def gelu_stage(p, x):
+        return x + jax.nn.gelu(x @ p["w"] + p["b"])
+
+    def tanh_stage(p, x):
+        return x + jnp.tanh(x @ p["w"] + p["b"])
+
+    fns = [gelu_stage if s % 2 == 0 else tanh_stage for s in range(S)]
+    het = switch_stage(fns)
+    x = jax.random.normal(jax.random.PRNGKey(4), (8, D), jnp.float32)
+    stacked = stack_stage_params(per_stage, mesh)
+    fwd = pipeline_apply(het, mesh, num_microbatches=4)
+
+    def loss_pp(params):
+        return jnp.sum(fwd(params, x) ** 2)
+
+    g_pp = jax.jit(jax.grad(loss_pp))(stacked)
+
+    def loss_seq(per_stage_tuple):
+        y = x
+        for s, p in enumerate(per_stage_tuple):
+            y = fns[s](p, y)
+        return jnp.sum(y ** 2)
+
+    g_seq = jax.grad(loss_seq)(tuple(per_stage))
+    for s in range(S):
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(g_pp[k][s]), np.asarray(g_seq[s][k]),
+                rtol=1e-4, atol=1e-5,
+            )
+
+
+def test_switch_stage_wrong_count_rejected(mesh):
+    from fluxdistributed_tpu.parallel.pp import switch_stage
+
+    het = switch_stage([stage_fn] * (S - 1))
+    with pytest.raises(ValueError, match="stage fns"):
+        pipeline_apply(het, mesh)
+
+
+def test_defaulted_third_arg_not_treated_as_stage(mesh, per_stage):
+    """A stage_fn with a defaulted third param keeps its default — the
+    stage index must not silently replace it."""
+
+    def scaled_stage(p, x, scale=0.5):
+        return x + scale * jax.nn.gelu(x @ p["w"] + p["b"])
+
+    x = jax.random.normal(jax.random.PRNGKey(5), (8, D), jnp.float32)
+    stacked = stack_stage_params(per_stage, mesh)
+    fwd = pipeline_apply(scaled_stage, mesh, num_microbatches=4)
+    got = np.asarray(jax.jit(fwd)(stacked, x))
+    want = x
+    for p in per_stage:
+        want = scaled_stage(p, want)
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5, atol=1e-5)
