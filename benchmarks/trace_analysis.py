@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Trace-backed breakdown of the ResNet-50 train step.
+
+Captures a ``jax.profiler`` trace of a few steady-state steps and parses
+the xplane protobuf in-process (``jax.profiler.ProfileData`` — no
+TensorBoard needed), aggregating device-op durations by fusion name.
+This is the "where do the milliseconds go" tool for docs/benchmarks.md.
+
+Usage: python benchmarks/trace_analysis.py [--steps 5] [--batch 256]
+       [--model resnet50] [--top 30] [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import os
+import re
+import tempfile
+
+
+def capture(args) -> str:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import fluxdistributed_tpu as fd
+    from fluxdistributed_tpu import optim, sharding
+    from fluxdistributed_tpu import models as models_lib
+    from fluxdistributed_tpu.parallel import TrainState, make_train_step
+    from fluxdistributed_tpu.parallel.dp import flax_loss_fn
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    mesh = fd.data_mesh()
+    model = getattr(models_lib, args.model)(num_classes=1000)
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (args.batch, args.size, args.size, 3)).astype(np.float32)
+    y = rng.integers(0, 1000, args.batch)
+    variables = model.init(jax.random.PRNGKey(0), x[:1], train=True)
+    params = variables["params"]
+    mstate = {k: v for k, v in variables.items() if k != "params"}
+    loss_fn = flax_loss_fn(model, fd.logitcrossentropy)
+    opt = optim.momentum(0.1, 0.9)
+    step = make_train_step(loss_fn, opt, mesh, donate=False)
+    state = TrainState.create(
+        sharding.replicate(params, mesh), opt,
+        model_state=sharding.replicate(mstate, mesh),
+    )
+    b = sharding.shard_batch(
+        {"image": x.astype(jnp.bfloat16),
+         "label": np.asarray(fd.onehot(y, 1000))}, mesh
+    )
+    # compile + warm
+    for _ in range(2):
+        state, m = step(state, b)
+    jax.block_until_ready(m["loss"])
+
+    trace_dir = args.trace_dir or tempfile.mkdtemp(prefix="fdtpu_trace_")
+    jax.profiler.start_trace(trace_dir)
+    for _ in range(args.steps):
+        state, m = step(state, b)
+    jax.block_until_ready(m["loss"])
+    jax.profiler.stop_trace()
+    return trace_dir
+
+
+_CLASS_PATTERNS = [
+    ("conv", re.compile(r"conv|%convolution", re.I)),
+    ("matmul", re.compile(r"dot|matmul", re.I)),
+    ("allreduce/collective", re.compile(r"all-reduce|all-gather|collective|reduce-scatter", re.I)),
+    ("batchnorm/elementwise", re.compile(r"fusion|add|multiply|subtract|divide|rsqrt|select", re.I)),
+    ("reduce", re.compile(r"reduce", re.I)),
+    ("copy/transpose", re.compile(r"copy|transpose|bitcast|reshape", re.I)),
+]
+
+
+def classify(name: str) -> str:
+    for label, pat in _CLASS_PATTERNS:
+        if pat.search(name):
+            return label
+    return "other"
+
+
+def analyze(trace_dir: str, top: int):
+    from jax.profiler import ProfileData
+
+    paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                             recursive=True))
+    if not paths:
+        raise SystemExit(f"no xplane.pb under {trace_dir}")
+    pd = ProfileData.from_file(paths[-1])
+
+    # pick accelerator device planes; on CPU there is no device plane, so
+    # fall back to the host plane and SAY SO — host traces mix Python
+    # frames in with XLA thunks and are not a device-op breakdown
+    best = []
+    for plane in pd.planes:
+        pname = plane.name or ""
+        if any(s in pname.lower() for s in ("tpu", "gpu", "device", "/xla:")):
+            best.append(plane)
+    host_fallback = not best
+    if host_fallback:
+        planes = [p for p in pd.planes if "cpu" in (p.name or "").lower()]
+        best = planes[:1]
+        if not best:
+            raise SystemExit(
+                f"no device plane in trace; planes = {[p.name for p in pd.planes]}"
+            )
+        print(
+            "WARNING: no accelerator plane found — analyzing the HOST plane "
+            "(includes Python/runtime frames; op classes are approximate). "
+            "Run on TPU for a real device breakdown.\n"
+        )
+
+    durs: dict[str, float] = collections.defaultdict(float)
+    counts: dict[str, int] = collections.defaultdict(int)
+    for plane in best:
+        for line in plane.lines:
+            for ev in line.events:
+                d = ev.duration_ns
+                if d is None:
+                    continue
+                durs[ev.name] += d / 1e6  # ms
+                counts[ev.name] += 1
+
+    total = sum(durs.values())
+    print(f"trace: {paths[-1]}")
+    print(f"planes analyzed: {[p.name for p in best]}")
+    print(f"total device-op time: {total:.1f} ms (all steps, incl. overlap)\n")
+
+    by_class: dict[str, float] = collections.defaultdict(float)
+    for name, ms in durs.items():
+        by_class[classify(name)] += ms
+    print("by op class:")
+    for label, ms in sorted(by_class.items(), key=lambda kv: -kv[1]):
+        print(f"  {label:26s} {ms:9.1f} ms  ({100 * ms / max(total, 1e-9):5.1f}%)")
+
+    print(f"\ntop {top} ops by total time:")
+    for name, ms in sorted(durs.items(), key=lambda kv: -kv[1])[:top]:
+        print(f"  {ms:9.2f} ms  x{counts[name]:<4d} {name[:110]}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--top", type=int, default=30)
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--trace-dir", default=None)
+    ap.add_argument("--analyze-only", default=None,
+                    help="skip capture; analyze this trace dir")
+    args = ap.parse_args()
+    trace_dir = args.analyze_only or capture(args)
+    analyze(trace_dir, args.top)
+
+
+if __name__ == "__main__":
+    main()

@@ -8,7 +8,7 @@ from .context import (
 )
 from .dp import TrainState, make_train_step, make_eval_step, make_train_step_shardmap
 from .ep import moe_apply, router_dispatch, stack_expert_params
-from .pp import make_train_step_pp, pipeline_apply, stack_stage_params
+from .pp import make_train_step_pp, pipeline_apply, stack_stage_params, switch_stage
 from .tp import make_train_step_tp, param_specs, shard_state, vit_tp_rules
 
 __all__ = [
@@ -33,6 +33,7 @@ __all__ = [
     "pipeline_apply",
     "make_train_step_pp",
     "stack_stage_params",
+    "switch_stage",
     "moe_apply",
     "router_dispatch",
     "stack_expert_params",
