@@ -7,7 +7,12 @@ from .context import (
     ulysses_attention,
 )
 from .dp import TrainState, make_train_step, make_eval_step, make_train_step_shardmap
-from .ep import moe_apply, router_dispatch, stack_expert_params
+from .ep import (
+    moe_apply,
+    router_dispatch,
+    router_dispatch_expert_choice,
+    stack_expert_params,
+)
 from .pp import make_train_step_pp, pipeline_apply, stack_stage_params, switch_stage
 from .tp import make_train_step_tp, param_specs, shard_state, vit_tp_rules
 
@@ -35,6 +40,7 @@ __all__ = [
     "stack_stage_params",
     "switch_stage",
     "moe_apply",
+    "router_dispatch_expert_choice",
     "router_dispatch",
     "stack_expert_params",
 ]

@@ -35,7 +35,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 Pytree = Any
 
-__all__ = ["moe_apply", "router_dispatch", "stack_expert_params"]
+__all__ = [
+    "moe_apply",
+    "router_dispatch",
+    "router_dispatch_expert_choice",
+    "stack_expert_params",
+]
 
 EXPERT_AXIS = "expert"
 
@@ -109,6 +114,29 @@ def router_dispatch(
     return dispatch, combine, aux
 
 
+def router_dispatch_expert_choice(logits: jnp.ndarray, capacity: int):
+    """Expert-choice dispatch/combine (Zhou et al. 2022): each EXPERT
+    picks its top-``capacity`` tokens by router probability, instead of
+    tokens picking experts.
+
+    Load balance is perfect by construction (every expert processes
+    exactly ``capacity`` token slots), so the aux loss is 0; tokens may
+    be processed by several experts or none.  Returns the same
+    ``(dispatch (T,E,C), combine, aux)`` contract as ``router_dispatch``.
+    """
+    t, e = logits.shape
+    dtype = logits.dtype
+    if capacity > t:
+        raise ValueError(
+            f"expert-choice capacity ({capacity}) cannot exceed tokens per shard ({t})"
+        )
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # (T, E)
+    _, idx = jax.lax.top_k(probs.T, capacity)  # (E, C) token ids per expert
+    dispatch_f32 = jax.nn.one_hot(idx, t, dtype=jnp.float32).transpose(2, 0, 1)
+    combine = (dispatch_f32 * probs[:, :, None]).astype(dtype)
+    return dispatch_f32.astype(dtype), combine, jnp.zeros((), jnp.float32)
+
+
 def moe_apply(
     expert_fn: Callable,
     mesh: Mesh,
@@ -116,6 +144,7 @@ def moe_apply(
     capacity_factor: float = 1.25,
     capacity: Optional[int] = None,
     top_k: int = 1,
+    routing: str = "token",
 ):
     """Build ``fn(stacked_params, router_w, x) -> (y, aux)``.
 
@@ -125,9 +154,15 @@ def moe_apply(
     ``E // axis_size`` experts (expert ``g`` lives on device ``g // L``,
     matching ``stack_expert_params``'s contiguous sharding).  Output is
     token-sharded like ``x``; ``aux`` is the replicated (pmean-ed)
-    load-balance loss.  ``top_k`` selects Switch (1) or GShard-style
-    top-k routing.
+    load-balance loss.  ``routing`` selects token-choice (``"token"``,
+    with ``top_k`` = 1 Switch / >1 GShard-style) or expert-choice
+    (``"expert_choice"``: each expert takes its top-C tokens; perfectly
+    balanced, aux = 0).
     """
+    if routing not in ("token", "expert_choice"):
+        raise ValueError(f"unknown routing {routing!r}")
+    if routing == "expert_choice" and top_k != 1:
+        raise ValueError("top_k applies to token-choice routing only")
     e_devices = mesh.shape[axis]
 
     @partial(
@@ -151,7 +186,10 @@ def moe_apply(
         else:
             cap = max(1, math.ceil(t / e * capacity_factor * top_k))
         logits = x @ router_w
-        dispatch, combine, aux = router_dispatch(logits, cap, k=top_k)
+        if routing == "expert_choice":
+            dispatch, combine, aux = router_dispatch_expert_choice(logits, cap)
+        else:
+            dispatch, combine, aux = router_dispatch(logits, cap, k=top_k)
         # (T,D),(T,E,C) → (E,C,D): each expert's queue from this shard
         expert_in = jnp.einsum("td,tec->ecd", x, dispatch)
         # exchange: device q receives every shard's queues for its LOC

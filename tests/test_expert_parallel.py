@@ -198,3 +198,79 @@ def test_moe_trains_end_to_end(mesh):
         params, opt_state, l = step(params, opt_state, i)
         losses.append(float(l))
     assert losses[-1] < losses[0] * 0.5, losses[::25]
+
+
+def test_expert_choice_matches_golden(mesh, setup):
+    """Expert-choice routing matches a dense single-shard reference."""
+    from fluxdistributed_tpu.parallel.ep import router_dispatch_expert_choice
+
+    per_expert, router_w, x = setup
+    t_shard = T // E
+    cap = 3  # each expert takes its top-3 tokens per shard
+    fn = moe_apply(expert_fn, mesh, capacity=cap, routing="expert_choice")
+    stacked = stack_expert_params(per_expert, mesh)
+    got, aux = fn(stacked, router_w, x)
+    got = np.asarray(got)
+    assert float(aux) == 0.0  # perfectly balanced by construction
+
+    outs = []
+    for s in range(E):
+        xs = x[s * t_shard : (s + 1) * t_shard]
+        logits = xs @ router_w
+        dispatch, combine, _ = router_dispatch_expert_choice(logits, cap)
+        expert_in = jnp.einsum("td,tec->ecd", xs, dispatch)
+        y = jnp.stack([expert_fn(p, expert_in[e]) for e, p in enumerate(per_expert)])
+        outs.append(np.asarray(jnp.einsum("ecd,tec->td", y, combine)))
+    np.testing.assert_allclose(got, np.concatenate(outs), rtol=1e-5, atol=1e-5)
+
+
+def test_expert_choice_every_expert_full():
+    """Every expert processes exactly `capacity` token slots."""
+    from fluxdistributed_tpu.parallel.ep import router_dispatch_expert_choice
+
+    logits = jnp.asarray(np.random.default_rng(0).normal(0, 1, (32, 4)), jnp.float32)
+    dispatch, _, _ = router_dispatch_expert_choice(logits, capacity=5)
+    d = np.asarray(dispatch)  # (T, E, C)
+    np.testing.assert_array_equal(d.sum(axis=(0, 2)), np.full(4, 5.0))
+    # each (expert, slot) holds exactly one token
+    np.testing.assert_array_equal(d.sum(axis=0), np.ones((4, 5)))
+
+
+def test_expert_choice_validations(mesh):
+    from fluxdistributed_tpu.parallel.ep import router_dispatch_expert_choice
+
+    with pytest.raises(ValueError, match="cannot exceed"):
+        router_dispatch_expert_choice(jnp.zeros((4, 2)), capacity=5)
+    with pytest.raises(ValueError, match="token-choice"):
+        moe_apply(expert_fn, mesh, routing="expert_choice", top_k=2)
+    with pytest.raises(ValueError, match="unknown routing"):
+        moe_apply(expert_fn, mesh, routing="nope")
+
+
+def test_expert_choice_multiple_experts_per_device(mesh):
+    """Expert-choice with E = 2x devices (LOC=2) matches the golden model
+    — guards the local-expert block ordering through the all_to_all."""
+    from fluxdistributed_tpu.parallel.ep import router_dispatch_expert_choice
+
+    e_total = 2 * E
+    keys = jax.random.split(jax.random.PRNGKey(10), e_total)
+    per_expert = [_expert_params(k) for k in keys]
+    router_w = jax.random.normal(jax.random.PRNGKey(11), (D, e_total), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(12), (T, D), jnp.float32)
+
+    t_shard = T // E
+    cap = 2
+    fn = moe_apply(expert_fn, mesh, capacity=cap, routing="expert_choice")
+    stacked = stack_expert_params(per_expert, mesh)
+    got, _ = fn(stacked, router_w, x)
+    got = np.asarray(got)
+
+    outs = []
+    for s in range(E):
+        xs = x[s * t_shard : (s + 1) * t_shard]
+        logits = xs @ router_w
+        dispatch, combine, _ = router_dispatch_expert_choice(logits, cap)
+        expert_in = jnp.einsum("td,tec->ecd", xs, dispatch)
+        y = jnp.stack([expert_fn(p, expert_in[e]) for e, p in enumerate(per_expert)])
+        outs.append(np.asarray(jnp.einsum("ecd,tec->td", y, combine)))
+    np.testing.assert_allclose(got, np.concatenate(outs), rtol=1e-5, atol=1e-5)
