@@ -42,7 +42,13 @@ from .dp import TrainState
 
 Pytree = Any
 
-__all__ = ["pipeline_apply", "make_train_step_pp", "stack_stage_params", "switch_stage"]
+__all__ = [
+    "pipeline_apply",
+    "make_train_step_pp",
+    "stack_stage_params",
+    "switch_stage",
+    "chunk_stages",
+]
 
 PIPE_AXIS = "pipe"
 
@@ -69,6 +75,32 @@ def _accepts_stage(fn: Callable) -> bool:
         and p.default is p.empty
     ]
     return len(required) >= 3
+
+
+def chunk_stages(stage_fn: Callable) -> Callable:
+    """Host V consecutive logical stages per pipe device (blocked virtual
+    pipeline): wraps ``stage_fn`` to ``lax.scan`` over a leading chunk
+    dim in its params, so device *s* applies logical stages
+    ``s·V … s·V+V-1`` in sequence each tick.
+
+    Build the params by stacking ALL ``V·S`` per-stage trees, reshaping
+    each leaf to ``(S, V, ...)``, and sharding the leading dim on the
+    pipe axis (``stack_stage_params`` of per-device ``(V, ...)`` trees
+    does exactly that).
+
+    Under this GPipe schedule, blocked placement keeps the bubble at
+    ``(S-1)/(M+S-1)`` ticks (each tick is V stage-times) — the same
+    relative bubble as a V-times-deeper per-device stage, which is what
+    it is.  Interleaved (Megatron 1F1B) placement is not implemented:
+    the backward here is AD-derived from the forward scan, so there is
+    no hand-written 1F1B schedule to interleave.
+    """
+
+    def fn(params, x):
+        h, _ = jax.lax.scan(lambda h, p: (stage_fn(p, h), None), x, params)
+        return h
+
+    return fn
 
 
 def switch_stage(stage_fns: list) -> Callable:
