@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Train-step configuration sweep for the ResNet-50 bench.
+
+Measures steady-state img/s for combinations of model/input dtype
+variants and XLA flags.  XLA flags bind at backend init, so the parent
+re-execs itself (``--one``) with each configuration's environment and
+collects one JSON line per child.
+
+Run on the real chip:  python benchmarks/step_sweep.py
+Child mode (internal): python benchmarks/step_sweep.py --one '<json>'
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+# bench.py (the shared timing protocol) lives at the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CONFIGS = [
+    {"name": "baseline-bf16", "env": {}},
+    {"name": "bn-f32", "env": {"SWEEP_BN_F32": "1"}},
+    {"name": "input-f32", "env": {"SWEEP_INPUT_F32": "1"}},
+    {"name": "latency-hiding-sched", "env": {
+        "SWEEP_XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=true"}},
+    {"name": "no-donate", "env": {"SWEEP_NO_DONATE": "1"}},
+    {"name": "batch-512", "env": {"SWEEP_BATCH": "512"}},
+    {"name": "grad-accum-2", "env": {"SWEEP_ACCUM": "2", "SWEEP_BATCH": "512"}},
+]
+
+
+def measure_one() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import fluxdistributed_tpu as fd
+    from fluxdistributed_tpu import optim, sharding
+    from fluxdistributed_tpu.models import resnet50
+    from fluxdistributed_tpu.parallel import TrainState, make_train_step
+    from fluxdistributed_tpu.parallel.dp import flax_loss_fn
+
+    batch = int(os.environ.get("SWEEP_BATCH", "256"))
+    size = int(os.environ.get("SWEEP_SIZE", "224"))
+    accum = int(os.environ.get("SWEEP_ACCUM", "1"))
+    donate = not os.environ.get("SWEEP_NO_DONATE")
+    bn_f32 = bool(os.environ.get("SWEEP_BN_F32"))
+    input_f32 = bool(os.environ.get("SWEEP_INPUT_F32"))
+
+    mesh = fd.data_mesh()
+    # bn-f32 variant: convs stay bf16, BatchNorm computes in f32
+    model = resnet50(
+        num_classes=1000,
+        norm_dtype=jnp.float32 if bn_f32 else None,
+    )
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (batch, size, size, 3)).astype(np.float32)
+    y = rng.integers(0, 1000, batch)
+    variables = model.init(jax.random.PRNGKey(0), x[:1], train=True)
+    params = variables["params"]
+    mstate = {k: v for k, v in variables.items() if k != "params"}
+    loss_fn = flax_loss_fn(model, fd.logitcrossentropy)
+    opt = optim.momentum(0.1, 0.9)
+    step = make_train_step(loss_fn, opt, mesh, donate=donate, accum_steps=accum)
+    state = TrainState.create(
+        sharding.replicate(params, mesh), opt,
+        model_state=sharding.replicate(mstate, mesh),
+    )
+    xb = x if input_f32 else x.astype(jnp.bfloat16)
+    b = sharding.shard_batch(
+        {"image": xb, "label": np.asarray(fd.onehot(y, 1000))}, mesh
+    )
+
+    import bench
+
+    dt, _ = bench.time_compiled_step(
+        step, state, b, target_seconds=float(os.environ.get("SWEEP_SECONDS", "2.0"))
+    )
+    return {
+        "img_per_sec_per_chip": round(batch / dt / jax.device_count(), 1),
+        "step_ms": round(dt * 1e3, 2),
+        "batch": batch,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--one", default=None)
+    args = ap.parse_args()
+    if args.one is not None:
+        print(json.dumps(measure_one()))
+        return
+
+    results = []
+    for cfg in CONFIGS:
+        env = {**os.environ, **cfg["env"]}
+        # APPEND sweep flags to pre-existing XLA_FLAGS so the row stays
+        # comparable to the others (which inherit the environment's flags)
+        extra = env.pop("SWEEP_XLA_FLAGS", None)
+        if extra:
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + extra).strip()
+        try:
+            # generous timeout — a timeout SIGKILL of a TPU child can
+            # leave the device grant wedged for every later config, so
+            # this is a last resort, not a scheduling tool
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--one", "{}"],
+                env=env, capture_output=True, text=True, timeout=1800,
+            )
+        except subprocess.TimeoutExpired as e:
+            results.append({"config": cfg["name"], "error": "timeout",
+                            "stderr": (e.stderr or "")[-300:]})
+            print(json.dumps(results[-1]), flush=True)
+            continue
+        lines = p.stdout.strip().splitlines()
+        r = None
+        if lines:
+            try:
+                r = json.loads(lines[-1])
+            except json.JSONDecodeError:
+                pass
+        if r is None or p.returncode != 0:
+            r = {"error": f"rc={p.returncode}",
+                 "stderr": p.stderr.strip()[-300:], **(r or {})}
+        results.append({"config": cfg["name"], **r})
+        print(json.dumps(results[-1]), flush=True)
+    print(json.dumps({"sweep": results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -98,6 +98,7 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     width: int = 64
     dtype: Any = jnp.bfloat16
+    norm_dtype: Any = None  # BatchNorm compute dtype; defaults to ``dtype``
     bn_momentum: float = 0.9
     bn_cross_replica_axis: str | None = None
 
@@ -115,7 +116,7 @@ class ResNet(nn.Module):
             use_running_average=not train,
             momentum=self.bn_momentum,
             epsilon=1e-5,
-            dtype=self.dtype,
+            dtype=self.norm_dtype if self.norm_dtype is not None else self.dtype,
             axis_name=self.bn_cross_replica_axis,
         )
         # Torch-convention explicit padding throughout (stem 3, 3x3 convs
