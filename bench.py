@@ -24,8 +24,11 @@ import traceback
 
 # First recorded value on the one available chip (TPU v5e, global batch
 # 256, bf16): ~2270 img/s/chip, reproduced across three bench runs
-# (2026-07-29).  Batch 128-512 measured flat within ~±5%; vs_baseline is
-# against the repeated 256/chip measurement.
+# (2026-07-29), measured under the then-current f32 input feed.  Batch
+# 128-512 measured flat within ~±5%; vs_baseline is against the repeated
+# 256/chip measurement.  The bench now feeds bf16, so vs_baseline
+# includes that protocol change until the constant is re-recorded on
+# hardware under the new feed.
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 2270.0
 
 
@@ -55,7 +58,21 @@ def time_compiled_step(step, state, b, target_seconds: float = 2.0):
     return (_time.perf_counter() - t0) / iters, iters
 
 
-def _measure():
+def build_step(
+    batch: int,
+    size: int = 224,
+    donate: bool = True,
+    accum_steps: int = 1,
+    norm_dtype=None,
+    input_f32: bool = False,
+):
+    """Build the headline measurement target: ResNet-50, DP mesh over all
+    chips, compiled train step, device-resident batch.
+
+    Returns ``(step, state, batch_dict)``.  This is THE protocol —
+    benchmarks/step_sweep.py varies its knobs through here so sweep rows
+    stay comparable to the headline number.
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -66,18 +83,10 @@ def _measure():
     from fluxdistributed_tpu.parallel import TrainState, make_train_step
     from fluxdistributed_tpu.parallel.dp import flax_loss_fn
 
-    platform = jax.devices()[0].platform
-    nchips = jax.device_count()
     mesh = fd.data_mesh()
-    # A 64→512 sweep on v5e: 64/chip is ~15% slower; 128–512 are flat
-    # within ~±5% (~2300 img/s).  256/chip sits mid-range and fits
-    # ResNet-50 activations comfortably.
-    per_chip_batch = 256 if platform == "tpu" else 8
-    batch = per_chip_batch * nchips
-
-    model = resnet50(num_classes=1000)
+    model = resnet50(num_classes=1000, norm_dtype=norm_dtype)
     rng = np.random.default_rng(0)
-    x = rng.normal(0, 1, (batch, 224, 224, 3)).astype(np.float32)
+    x = rng.normal(0, 1, (batch, size, size, 3)).astype(np.float32)
     y = rng.integers(0, 1000, batch)
 
     variables = model.init(jax.random.PRNGKey(0), x[:1], train=True)
@@ -86,16 +95,31 @@ def _measure():
 
     loss_fn = flax_loss_fn(model, fd.logitcrossentropy)
     opt = optim.momentum(0.1, 0.9)
-    step = make_train_step(loss_fn, opt, mesh)
+    step = make_train_step(loss_fn, opt, mesh, donate=donate, accum_steps=accum_steps)
     state = TrainState.create(
         sharding.replicate(params, mesh), opt, model_state=sharding.replicate(mstate, mesh)
     )
-    # feed bf16: the model casts to bf16 at its input anyway, so feeding
-    # f32 only adds a 2x-wider HBM read + an in-graph convert per step
+    # feed bf16 by default: the model casts to bf16 at its input anyway,
+    # so an f32 feed only adds a 2x-wider HBM read + an in-graph convert
+    xb = x if input_f32 else x.astype(jnp.bfloat16)
     b = sharding.shard_batch(
-        {"image": x.astype(jnp.bfloat16), "label": np.asarray(fd.onehot(y, 1000))}, mesh
+        {"image": xb, "label": np.asarray(fd.onehot(y, 1000))}, mesh
     )
+    return step, state, b
 
+
+def _measure():
+    import jax
+
+    platform = jax.devices()[0].platform
+    nchips = jax.device_count()
+    # A 64→512 sweep on v5e: 64/chip is ~15% slower; 128–512 are flat
+    # within ~±5% (~2300 img/s).  256/chip sits mid-range and fits
+    # ResNet-50 activations comfortably.
+    per_chip_batch = 256 if platform == "tpu" else 8
+    batch = per_chip_batch * nchips
+
+    step, state, b = build_step(batch)
     dt, _ = time_compiled_step(step, state, b)
 
     ips_per_chip = batch / dt / nchips

@@ -7,7 +7,8 @@ re-execs itself (``--one``) with each configuration's environment and
 collects one JSON line per child.
 
 Run on the real chip:  python benchmarks/step_sweep.py
-Child mode (internal): python benchmarks/step_sweep.py --one '<json>'
+Child mode (internal): python benchmarks/step_sweep.py --one
+(configuration reaches the child via SWEEP_* environment variables)
 """
 
 from __future__ import annotations
@@ -36,48 +37,18 @@ CONFIGS = [
 def measure_one() -> dict:
     import jax
     import jax.numpy as jnp
-    import numpy as np
-
-    import fluxdistributed_tpu as fd
-    from fluxdistributed_tpu import optim, sharding
-    from fluxdistributed_tpu.models import resnet50
-    from fluxdistributed_tpu.parallel import TrainState, make_train_step
-    from fluxdistributed_tpu.parallel.dp import flax_loss_fn
-
-    batch = int(os.environ.get("SWEEP_BATCH", "256"))
-    size = int(os.environ.get("SWEEP_SIZE", "224"))
-    accum = int(os.environ.get("SWEEP_ACCUM", "1"))
-    donate = not os.environ.get("SWEEP_NO_DONATE")
-    bn_f32 = bool(os.environ.get("SWEEP_BN_F32"))
-    input_f32 = bool(os.environ.get("SWEEP_INPUT_F32"))
-
-    mesh = fd.data_mesh()
-    # bn-f32 variant: convs stay bf16, BatchNorm computes in f32
-    model = resnet50(
-        num_classes=1000,
-        norm_dtype=jnp.float32 if bn_f32 else None,
-    )
-
-    rng = np.random.default_rng(0)
-    x = rng.normal(0, 1, (batch, size, size, 3)).astype(np.float32)
-    y = rng.integers(0, 1000, batch)
-    variables = model.init(jax.random.PRNGKey(0), x[:1], train=True)
-    params = variables["params"]
-    mstate = {k: v for k, v in variables.items() if k != "params"}
-    loss_fn = flax_loss_fn(model, fd.logitcrossentropy)
-    opt = optim.momentum(0.1, 0.9)
-    step = make_train_step(loss_fn, opt, mesh, donate=donate, accum_steps=accum)
-    state = TrainState.create(
-        sharding.replicate(params, mesh), opt,
-        model_state=sharding.replicate(mstate, mesh),
-    )
-    xb = x if input_f32 else x.astype(jnp.bfloat16)
-    b = sharding.shard_batch(
-        {"image": xb, "label": np.asarray(fd.onehot(y, 1000))}, mesh
-    )
 
     import bench
 
+    batch = int(os.environ.get("SWEEP_BATCH", "256"))
+    step, state, b = bench.build_step(
+        batch,
+        size=int(os.environ.get("SWEEP_SIZE", "224")),
+        donate=not os.environ.get("SWEEP_NO_DONATE"),
+        accum_steps=int(os.environ.get("SWEEP_ACCUM", "1")),
+        norm_dtype=jnp.float32 if os.environ.get("SWEEP_BN_F32") else None,
+        input_f32=bool(os.environ.get("SWEEP_INPUT_F32")),
+    )
     dt, _ = bench.time_compiled_step(
         step, state, b, target_seconds=float(os.environ.get("SWEEP_SECONDS", "2.0"))
     )
@@ -91,9 +62,10 @@ def measure_one() -> dict:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--one", default=None)
+    ap.add_argument("--one", action="store_true",
+                    help="child mode: measure the SWEEP_* env configuration")
     args = ap.parse_args()
-    if args.one is not None:
+    if args.one:
         print(json.dumps(measure_one()))
         return
 
@@ -110,12 +82,16 @@ def main():
             # leave the device grant wedged for every later config, so
             # this is a last resort, not a scheduling tool
             p = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--one", "{}"],
+                [sys.executable, os.path.abspath(__file__), "--one"],
                 env=env, capture_output=True, text=True, timeout=1800,
             )
         except subprocess.TimeoutExpired as e:
+            # TimeoutExpired.stderr is bytes even under text=True
+            err = e.stderr or b""
+            if isinstance(err, bytes):
+                err = err.decode(errors="replace")
             results.append({"config": cfg["name"], "error": "timeout",
-                            "stderr": (e.stderr or "")[-300:]})
+                            "stderr": err[-300:]})
             print(json.dumps(results[-1]), flush=True)
             continue
         lines = p.stdout.strip().splitlines()

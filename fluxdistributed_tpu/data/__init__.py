@@ -3,6 +3,7 @@ from .imagenet import ImageNetDataset, SampleTable, labels, makepaths, train_sol
 from .loader import PrefetchLoader
 from .preprocess import preprocess
 from .registry import load_registry, open_dataset, register_dataset
+from .sources import FileSource, GCSSource, HTTPSource, make_source
 from .synthetic import SyntheticDataset
 
 __all__ = [
@@ -17,6 +18,10 @@ __all__ = [
     "load_registry",
     "open_dataset",
     "register_dataset",
+    "FileSource",
+    "HTTPSource",
+    "GCSSource",
+    "make_source",
     "SyntheticDataset",
     "minibatch",
 ]
