@@ -34,6 +34,11 @@ CONFIGS = [
 ]
 
 
+def _env_flag(name: str) -> bool:
+    """'1'/'true'/'yes' enable, ''/'0'/'false'/'no'/unset disable."""
+    return os.environ.get(name, "").strip().lower() in ("1", "true", "yes", "on")
+
+
 def measure_one() -> dict:
     import jax
     import jax.numpy as jnp
@@ -44,10 +49,10 @@ def measure_one() -> dict:
     step, state, b = bench.build_step(
         batch,
         size=int(os.environ.get("SWEEP_SIZE", "224")),
-        donate=not os.environ.get("SWEEP_NO_DONATE"),
+        donate=not _env_flag("SWEEP_NO_DONATE"),
         accum_steps=int(os.environ.get("SWEEP_ACCUM", "1")),
-        norm_dtype=jnp.float32 if os.environ.get("SWEEP_BN_F32") else None,
-        input_f32=bool(os.environ.get("SWEEP_INPUT_F32")),
+        norm_dtype=jnp.float32 if _env_flag("SWEEP_BN_F32") else None,
+        input_f32=_env_flag("SWEEP_INPUT_F32"),
     )
     dt, _ = bench.time_compiled_step(
         step, state, b, target_seconds=float(os.environ.get("SWEEP_SECONDS", "2.0"))

@@ -1,0 +1,55 @@
+"""Guard the driver-facing benchmark harness.
+
+bench.py is the artifact the round driver executes on real hardware; a
+breakage there records a failed round, so its construction path and
+always-emit-JSON contract get CI coverage on the fake mesh.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bench_mod():
+    import pathlib
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    import bench
+
+    return bench
+
+
+def test_build_step_runs_one_step(bench_mod):
+    step, state, b = bench_mod.build_step(batch=8, size=32)
+    state2, m = step(state, b)
+    assert float(m["loss"]) > 0
+    assert int(state2.step) == 1
+
+
+def test_build_step_variant_knobs(bench_mod):
+    import jax.numpy as jnp
+
+    step, state, b = bench_mod.build_step(
+        batch=8, size=32, donate=False, accum_steps=2,
+        norm_dtype=jnp.float32, input_f32=True,
+    )
+    _, m = step(state, b)
+    assert float(m["loss"]) > 0
+    assert b["image"].dtype == jnp.float32
+
+
+def test_main_emits_error_json_and_rc0_on_failure(bench_mod, monkeypatch, capsys):
+    def boom():
+        raise RuntimeError("injected failure")
+
+    monkeypatch.setattr(bench_mod, "_measure", boom)
+    monkeypatch.setattr(bench_mod.time, "sleep", lambda s: None)
+    bench_mod.main()  # must not raise
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["unit"] == "images/sec/chip"
+    assert "injected failure" in out["error"]
