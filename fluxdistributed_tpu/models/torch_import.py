@@ -21,7 +21,7 @@ from typing import Any, Mapping, Sequence
 
 import numpy as np
 
-__all__ = ["import_torch_resnet", "load_torch_file"]
+__all__ = ["import_torch_resnet", "import_torch_vit", "load_torch_file"]
 
 # stage_sizes per depth, matching models/resnet.py factories
 _STAGES = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
@@ -98,11 +98,101 @@ def import_torch_resnet(
     return params, {"batch_stats": stats}
 
 
-def load_torch_file(path: str, depth: int = 50) -> tuple[dict, dict]:
-    """Load a .pt/.pth checkpoint file and convert (requires torch)."""
+def import_torch_vit(
+    state_dict: Mapping[str, Any], num_heads: int
+) -> tuple[dict, dict]:
+    """Convert a torchvision-layout ``VisionTransformer`` state_dict
+    (`conv_proj`, `class_token`, `encoder.layers.encoder_layer_{i}`,
+    `heads.head`) to ``(params, model_state)`` for a ``ViT`` built with
+    ``use_class_token=True, gelu_exact=True`` (the torchvision form; the
+    framework default stays mean-pool + tanh GELU for SP shardability).
+
+    ``model_state`` is ``{}`` — ViT has no mutable collections.
+    """
+    d = _np(state_dict["class_token"]).shape[-1]
+    if d % num_heads:
+        raise ValueError(f"embed dim {d} not divisible by num_heads {num_heads}")
+    hd = d // num_heads
+
+    params: dict = {
+        "patch_embed": {
+            "kernel": _conv(state_dict, "conv_proj"),
+            "bias": _np(state_dict["conv_proj.bias"]),
+        },
+        "cls_token": _np(state_dict["class_token"]),
+        "pos_embed": _np(state_dict["encoder.pos_embedding"]),
+        "final_norm": {
+            "scale": _np(state_dict["encoder.ln.weight"]),
+            "bias": _np(state_dict["encoder.ln.bias"]),
+        },
+        "head": {
+            "kernel": _np(state_dict["heads.head.weight"]).T,
+            "bias": _np(state_dict["heads.head.bias"]),
+        },
+    }
+
+    i = 0
+    while f"encoder.layers.encoder_layer_{i}.ln_1.weight" in state_dict:
+        t = f"encoder.layers.encoder_layer_{i}"
+        # torch in_proj packs [q; k; v] rows of an (3D, D) weight applied
+        # as x @ W.T -> transpose to (D, 3D) then split into (D, 3, H, Dh)
+        w_in = _np(state_dict[f"{t}.self_attention.in_proj_weight"]).T
+        b_in = _np(state_dict[f"{t}.self_attention.in_proj_bias"])
+        w_out = _np(state_dict[f"{t}.self_attention.out_proj.weight"]).T
+        params[f"block{i}"] = {
+            "LayerNorm_0": {
+                "scale": _np(state_dict[f"{t}.ln_1.weight"]),
+                "bias": _np(state_dict[f"{t}.ln_1.bias"]),
+            },
+            "MultiHeadAttention_0": {
+                "qkv": {
+                    "kernel": w_in.reshape(d, 3, num_heads, hd),
+                    "bias": b_in.reshape(3, num_heads, hd),
+                },
+                "out": {
+                    "kernel": w_out.reshape(num_heads, hd, d),
+                    "bias": _np(state_dict[f"{t}.self_attention.out_proj.bias"]),
+                },
+            },
+            "LayerNorm_1": {
+                "scale": _np(state_dict[f"{t}.ln_2.weight"]),
+                "bias": _np(state_dict[f"{t}.ln_2.bias"]),
+            },
+            "MlpBlock_0": {
+                "Dense_0": {
+                    "kernel": _np(state_dict[f"{t}.mlp.0.weight"]).T,
+                    "bias": _np(state_dict[f"{t}.mlp.0.bias"]),
+                },
+                "Dense_1": {
+                    "kernel": _np(state_dict[f"{t}.mlp.3.weight"]).T,
+                    "bias": _np(state_dict[f"{t}.mlp.3.bias"]),
+                },
+            },
+        }
+        i += 1
+    if i == 0:
+        raise ValueError("no encoder layers found — not a torchvision ViT state_dict")
+    return params, {}
+
+
+def load_torch_file(
+    path: str,
+    depth: int = 50,
+    arch: str = "resnet",
+    num_heads: int = 12,
+) -> tuple[dict, dict]:
+    """Load a .pt/.pth checkpoint file and convert (requires torch).
+
+    ``arch``: ``"resnet"`` (uses ``depth``) or ``"vit"`` (uses
+    ``num_heads``).
+    """
     import torch
 
     obj = torch.load(path, map_location="cpu", weights_only=True)
     if isinstance(obj, dict) and "state_dict" in obj:
         obj = obj["state_dict"]
-    return import_torch_resnet(obj, depth=depth)
+    if arch == "resnet":
+        return import_torch_resnet(obj, depth=depth)
+    if arch == "vit":
+        return import_torch_vit(obj, num_heads=num_heads)
+    raise ValueError(f"unknown arch {arch!r}; expected 'resnet' or 'vit'")

@@ -30,12 +30,13 @@ class MlpBlock(nn.Module):
     mlp_dim: int
     dtype: Any = jnp.bfloat16
     dropout: float = 0.0
+    gelu_exact: bool = False  # erf GELU (torch default) vs tanh approx (TPU-fast)
 
     @nn.compact
     def __call__(self, x, *, train: bool):
         d = x.shape[-1]
         x = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
-        x = nn.gelu(x)
+        x = nn.gelu(x, approximate=not self.gelu_exact)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
         x = nn.Dense(d, dtype=self.dtype)(x)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
@@ -69,6 +70,7 @@ class EncoderBlock(nn.Module):
     dtype: Any = jnp.bfloat16
     dropout: float = 0.0
     attn_fn: Optional[AttnFn] = None
+    gelu_exact: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool):
@@ -77,7 +79,10 @@ class EncoderBlock(nn.Module):
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype)(x)
-        y = MlpBlock(self.mlp_dim, dtype=self.dtype, dropout=self.dropout)(y, train=train)
+        y = MlpBlock(
+            self.mlp_dim, dtype=self.dtype, dropout=self.dropout,
+            gelu_exact=self.gelu_exact,
+        )(y, train=train)
         return x + y
 
 
@@ -99,6 +104,11 @@ class ViT(nn.Module):
     dtype: Any = jnp.bfloat16
     dropout: float = 0.0
     attn_fn: Optional[AttnFn] = None
+    # torchvision-compat switches (models/torch_import.py): class-token
+    # readout instead of mean pooling, and exact (erf) GELU.  Defaults
+    # stay mean-pool + tanh GELU — the SP-shardable, TPU-fast form.
+    use_class_token: bool = False
+    gelu_exact: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -110,18 +120,28 @@ class ViT(nn.Module):
         )(x)
         b, h, w, c = x.shape
         x = x.reshape(b, h * w, c)
+        ntok = h * w
+        if self.use_class_token:
+            cls = self.param(
+                "cls_token", nn.initializers.zeros, (1, 1, self.dim), jnp.float32
+            )
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls.astype(self.dtype), (b, 1, c)), x], axis=1
+            )
+            ntok += 1
         pos = self.param(
-            "pos_embed", nn.initializers.normal(0.02), (1, h * w, self.dim), jnp.float32
+            "pos_embed", nn.initializers.normal(0.02), (1, ntok, self.dim), jnp.float32
         )
         x = x + pos.astype(self.dtype)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
         for i in range(self.depth):
             x = EncoderBlock(
                 self.num_heads, self.mlp_dim, dtype=self.dtype,
-                dropout=self.dropout, attn_fn=self.attn_fn, name=f"block{i}",
+                dropout=self.dropout, attn_fn=self.attn_fn,
+                gelu_exact=self.gelu_exact, name=f"block{i}",
             )(x, train=train)
         x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
-        x = x.mean(axis=1)
+        x = x[:, 0] if self.use_class_token else x.mean(axis=1)
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
         return x.astype(jnp.float32)
 

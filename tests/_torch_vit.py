@@ -1,0 +1,74 @@
+"""Minimal torch ViT with torchvision-compatible parameter names.
+
+Test fixture only (torchvision is not in this image): the standard
+Vision Transformer (Dosovitskiy et al.) with exactly the state_dict
+layout torchvision's ``VisionTransformer`` exports — ``conv_proj``,
+``class_token``, ``encoder.pos_embedding``,
+``encoder.layers.encoder_layer_{i}.{ln_1,self_attention,ln_2,mlp}``,
+``encoder.ln``, ``heads.head`` — consumed by
+``models/torch_import.py::import_torch_vit``.
+"""
+
+from __future__ import annotations
+
+import torch
+import torch.nn as nn
+
+
+class EncoderLayer(nn.Module):
+    def __init__(self, dim, heads, mlp_dim):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(dim, eps=1e-6)
+        self.self_attention = nn.MultiheadAttention(dim, heads, batch_first=True)
+        self.ln_2 = nn.LayerNorm(dim, eps=1e-6)
+        # torchvision MLPBlock is an nn.Sequential: 0 Linear, 1 GELU,
+        # 2 Dropout, 3 Linear, 4 Dropout -> keys mlp.0.* / mlp.3.*
+        self.mlp = nn.Sequential(
+            nn.Linear(dim, mlp_dim), nn.GELU(), nn.Dropout(0.0),
+            nn.Linear(mlp_dim, dim), nn.Dropout(0.0),
+        )
+
+    def forward(self, x):
+        y = self.ln_1(x)
+        y, _ = self.self_attention(y, y, y, need_weights=False)
+        x = x + y
+        return x + self.mlp(self.ln_2(x))
+
+
+class Encoder(nn.Module):
+    def __init__(self, ntok, dim, depth, heads, mlp_dim):
+        super().__init__()
+        self.pos_embedding = nn.Parameter(torch.empty(1, ntok, dim).normal_(std=0.02))
+        self.layers = nn.ModuleDict(
+            {f"encoder_layer_{i}": EncoderLayer(dim, heads, mlp_dim)
+             for i in range(depth)}
+        )
+        self.ln = nn.LayerNorm(dim, eps=1e-6)
+
+    def forward(self, x):
+        x = x + self.pos_embedding
+        for i in range(len(self.layers)):
+            x = self.layers[f"encoder_layer_{i}"](x)
+        return self.ln(x)
+
+
+class TorchViT(nn.Module):
+    def __init__(self, image_size=32, patch=8, dim=64, depth=2, heads=4,
+                 mlp_dim=128, num_classes=10):
+        super().__init__()
+        ntok = (image_size // patch) ** 2 + 1
+        self.patch = patch
+        self.conv_proj = nn.Conv2d(3, dim, patch, patch)
+        self.class_token = nn.Parameter(torch.zeros(1, 1, dim))
+        self.encoder = Encoder(ntok, dim, depth, heads, mlp_dim)
+        self.heads = nn.Sequential()
+        self.heads.add_module("head", nn.Linear(dim, num_classes))
+
+    def forward(self, x):
+        n = x.shape[0]
+        x = self.conv_proj(x)  # (N, D, H', W')
+        x = x.flatten(2).transpose(1, 2)  # (N, HW, D)
+        cls = self.class_token.expand(n, -1, -1)
+        x = torch.cat([cls, x], dim=1)
+        x = self.encoder(x)
+        return self.heads(x[:, 0])

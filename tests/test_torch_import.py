@@ -76,3 +76,56 @@ def test_param_tree_shapes_match_init():
     got_s = jax.tree.map(np.shape, mstate["batch_stats"])
     want_s = jax.tree.map(np.shape, ref_vars["batch_stats"])
     assert got_s == want_s
+
+
+def test_vit_logit_parity():
+    """torchvision-layout ViT weights -> flax ViT, logit parity."""
+    import jax.numpy as jnp2
+
+    from fluxdistributed_tpu.models import ViT
+    from fluxdistributed_tpu.models.torch_import import import_torch_vit
+
+    from _torch_vit import TorchViT
+
+    torch.manual_seed(0)
+    tm = TorchViT(image_size=32, patch=8, dim=64, depth=2, heads=4,
+                  mlp_dim=128, num_classes=10).eval()
+    # random weights everywhere (default init leaves cls_token zero)
+    with torch.no_grad():
+        tm.class_token.normal_(std=0.02)
+    params, mstate = import_torch_vit(tm.state_dict(), num_heads=4)
+
+    model = ViT(patch=8, depth=2, dim=64, num_heads=4, mlp_dim=128,
+                num_classes=10, dtype=jnp2.float32,
+                use_class_token=True, gelu_exact=True)
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (2, 32, 32, 3)).astype(np.float32)
+
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    out = np.asarray(model.apply({"params": params, **mstate}, x, train=False))
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_vit_import_tree_matches_init():
+    import jax
+
+    from fluxdistributed_tpu.models import ViT
+    from fluxdistributed_tpu.models.torch_import import import_torch_vit
+
+    from _torch_vit import TorchViT
+
+    torch.manual_seed(1)
+    tm = TorchViT(image_size=32, patch=8, dim=64, depth=2, heads=4,
+                  mlp_dim=128, num_classes=10)
+    params, _ = import_torch_vit(tm.state_dict(), num_heads=4)
+
+    import jax.numpy as jnp2
+
+    model = ViT(patch=8, depth=2, dim=64, num_heads=4, mlp_dim=128,
+                num_classes=10, dtype=jnp2.float32, use_class_token=True)
+    ref = model.init(jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32),
+                     train=False)
+    got = jax.tree.map(np.shape, params)
+    want = jax.tree.map(np.shape, ref["params"])
+    assert got == want
