@@ -87,23 +87,20 @@ def main(argv=None) -> int:
         ).astype(np.float32)
         row_names = ["<synthetic>"]
 
-    variables = model.init(jax.random.PRNGKey(0), batch[:1], train=False)
     if args.torch_weights and args.checkpoint:
         print("--torch-weights and --checkpoint are mutually exclusive", file=sys.stderr)
         return 2
     if args.torch_weights:
-        from fluxdistributed_tpu.models.torch_import import load_torch_file
+        from fluxdistributed_tpu.models.torch_import import load_torch_weights_for
 
-        if not args.model.startswith("resnet") or not args.model[6:].isdigit():
-            print(
-                f"--torch-weights requires a resnet model (resnet18/34/50/101/152), "
-                f"got {args.model!r}",
-                file=sys.stderr,
+        try:
+            model, variables = load_torch_weights_for(
+                args.model, args.num_classes, args.torch_weights
             )
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
             return 2
-        params, mstate = load_torch_file(args.torch_weights, depth=int(args.model[6:]))
-        variables = {"params": params, **mstate}
-        print(f"loaded torchvision-layout weights from {args.torch_weights}")
+        print(f"loaded torch-layout weights from {args.torch_weights}")
     elif args.checkpoint:
         from fluxdistributed_tpu.train.checkpoint import load_checkpoint
 
@@ -112,6 +109,8 @@ def main(argv=None) -> int:
         restored = load_checkpoint(args.checkpoint, step=args.step)
         variables = {"params": restored["params"], **restored.get("model_state", {})}
         print(f"restored checkpoint step {int(restored['step'])} from {args.checkpoint}")
+    else:
+        variables = model.init(jax.random.PRNGKey(0), batch[:1], train=False)
 
     @jax.jit
     def forward(variables, x):

@@ -98,28 +98,25 @@ def make_app(args):
         raise SystemExit(f"unknown model {args.model!r}")
     if args.torch_weights and args.checkpoint:
         raise SystemExit("--torch-weights and --checkpoint are mutually exclusive")
-    if args.torch_weights and (
-        not args.model.startswith("resnet") or not args.model[6:].isdigit()
-    ):
-        raise SystemExit(
-            "--torch-weights requires a resnet model "
-            f"(resnet18/34/50/101/152), got {args.model!r}"
-        )
-    model = factory(num_classes=args.num_classes)
     dummy = np.zeros((1, 224, 224, 3), np.float32)
-    variables = model.init(jax.random.PRNGKey(0), dummy, train=False)
     if args.torch_weights:
-        from fluxdistributed_tpu.models.torch_import import load_torch_file
+        from fluxdistributed_tpu.models.torch_import import load_torch_weights_for
 
-        params, mstate = load_torch_file(
-            args.torch_weights, depth=int(args.model[6:])
-        )
-        variables = {"params": params, **mstate}
+        try:
+            model, variables = load_torch_weights_for(
+                args.model, args.num_classes, args.torch_weights
+            )
+        except ValueError as e:
+            raise SystemExit(str(e))
     elif args.checkpoint:
+        model = factory(num_classes=args.num_classes)
         from fluxdistributed_tpu.train.checkpoint import load_checkpoint
 
         restored = load_checkpoint(args.checkpoint)
         variables = {"params": restored["params"], **restored.get("model_state", {})}
+    else:
+        model = factory(num_classes=args.num_classes)
+        variables = model.init(jax.random.PRNGKey(0), dummy, train=False)
 
     names = None
     if args.synset:

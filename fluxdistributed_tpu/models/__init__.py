@@ -8,7 +8,12 @@ from .convnext import (
     convnext_xlarge,
 )
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
-from .torch_import import import_torch_resnet, import_torch_vit, load_torch_file
+from .torch_import import (
+    import_torch_convnext,
+    import_torch_resnet,
+    import_torch_vit,
+    load_torch_file,
+)
 from .simple import SimpleCNN, MLP
 from .vit import ViT, vit_tiny, vit_b16, vit_l16, vit_h14
 
@@ -28,6 +33,7 @@ __all__ = [
     "resnet152",
     "import_torch_resnet",
     "import_torch_vit",
+    "import_torch_convnext",
     "load_torch_file",
     "SimpleCNN",
     "MLP",

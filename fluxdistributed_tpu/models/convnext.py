@@ -44,6 +44,7 @@ class ConvNeXtBlock(nn.Module):
     drop_path: float = 0.0
     layer_scale_init: float = 1e-6
     dtype: Any = jnp.bfloat16
+    gelu_exact: bool = False  # erf GELU (torch default) vs tanh approx (TPU-fast)
 
     @nn.compact
     def __call__(self, x, *, train: bool):
@@ -55,7 +56,7 @@ class ConvNeXtBlock(nn.Module):
         )(x)
         x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)
         x = nn.Dense(4 * self.dim, dtype=self.dtype, name="pwconv1")(x)
-        x = nn.gelu(x)
+        x = nn.gelu(x, approximate=not self.gelu_exact)
         x = nn.Dense(self.dim, dtype=self.dtype, name="pwconv2")(x)
         gamma = self.param(
             "layer_scale",
@@ -94,6 +95,7 @@ class ConvNeXt(nn.Module):
     drop_path_rate: float = 0.0
     layer_scale_init: float = 1e-6
     dtype: Any = jnp.bfloat16
+    gelu_exact: bool = False  # torchvision/official-checkpoint compat
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -112,7 +114,8 @@ class ConvNeXt(nn.Module):
                 dp = self.drop_path_rate * block / max(total - 1, 1)
                 x = ConvNeXtBlock(
                     dim, drop_path=dp, layer_scale_init=self.layer_scale_init,
-                    dtype=self.dtype, name=f"block{block}",
+                    dtype=self.dtype, gelu_exact=self.gelu_exact,
+                    name=f"block{block}",
                 )(x, train=train)
                 block += 1
         x = x.mean(axis=(1, 2))

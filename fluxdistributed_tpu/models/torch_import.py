@@ -21,7 +21,12 @@ from typing import Any, Mapping, Sequence
 
 import numpy as np
 
-__all__ = ["import_torch_resnet", "import_torch_vit", "load_torch_file"]
+__all__ = [
+    "import_torch_resnet",
+    "import_torch_vit",
+    "import_torch_convnext",
+    "load_torch_file",
+]
 
 # stage_sizes per depth, matching models/resnet.py factories
 _STAGES = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
@@ -46,6 +51,14 @@ def _bn(sd: Mapping, name: str):
     stats = {"mean": _np(sd[f"{name}.running_mean"]),
              "var": _np(sd[f"{name}.running_var"])}
     return params, stats
+
+
+def _ln(sd: Mapping, name: str) -> dict:
+    return {"scale": _np(sd[f"{name}.weight"]), "bias": _np(sd[f"{name}.bias"])}
+
+
+def _linear(sd: Mapping, name: str) -> dict:
+    return {"kernel": _np(sd[f"{name}.weight"]).T, "bias": _np(sd[f"{name}.bias"])}
 
 
 def import_torch_resnet(
@@ -121,14 +134,8 @@ def import_torch_vit(
         },
         "cls_token": _np(state_dict["class_token"]),
         "pos_embed": _np(state_dict["encoder.pos_embedding"]),
-        "final_norm": {
-            "scale": _np(state_dict["encoder.ln.weight"]),
-            "bias": _np(state_dict["encoder.ln.bias"]),
-        },
-        "head": {
-            "kernel": _np(state_dict["heads.head.weight"]).T,
-            "bias": _np(state_dict["heads.head.bias"]),
-        },
+        "final_norm": _ln(state_dict, "encoder.ln"),
+        "head": _linear(state_dict, "heads.head"),
     }
 
     i = 0
@@ -139,11 +146,15 @@ def import_torch_vit(
         w_in = _np(state_dict[f"{t}.self_attention.in_proj_weight"]).T
         b_in = _np(state_dict[f"{t}.self_attention.in_proj_bias"])
         w_out = _np(state_dict[f"{t}.self_attention.out_proj.weight"]).T
+        # mlp keys: torchvision >=0.13 exports mlp.0/mlp.3 (Sequential);
+        # the published .pth checkpoint FILES carry the pre-0.13
+        # mlp.linear_1/linear_2 names (torchvision renames them in a
+        # load_state_dict pre-hook) — accept both
+        mlp1, mlp2 = f"{t}.mlp.0", f"{t}.mlp.3"
+        if f"{t}.mlp.linear_1.weight" in state_dict:
+            mlp1, mlp2 = f"{t}.mlp.linear_1", f"{t}.mlp.linear_2"
         params[f"block{i}"] = {
-            "LayerNorm_0": {
-                "scale": _np(state_dict[f"{t}.ln_1.weight"]),
-                "bias": _np(state_dict[f"{t}.ln_1.bias"]),
-            },
+            "LayerNorm_0": _ln(state_dict, f"{t}.ln_1"),
             "MultiHeadAttention_0": {
                 "qkv": {
                     "kernel": w_in.reshape(d, 3, num_heads, hd),
@@ -154,24 +165,68 @@ def import_torch_vit(
                     "bias": _np(state_dict[f"{t}.self_attention.out_proj.bias"]),
                 },
             },
-            "LayerNorm_1": {
-                "scale": _np(state_dict[f"{t}.ln_2.weight"]),
-                "bias": _np(state_dict[f"{t}.ln_2.bias"]),
-            },
+            "LayerNorm_1": _ln(state_dict, f"{t}.ln_2"),
             "MlpBlock_0": {
-                "Dense_0": {
-                    "kernel": _np(state_dict[f"{t}.mlp.0.weight"]).T,
-                    "bias": _np(state_dict[f"{t}.mlp.0.bias"]),
-                },
-                "Dense_1": {
-                    "kernel": _np(state_dict[f"{t}.mlp.3.weight"]).T,
-                    "bias": _np(state_dict[f"{t}.mlp.3.bias"]),
-                },
+                "Dense_0": _linear(state_dict, mlp1),
+                "Dense_1": _linear(state_dict, mlp2),
             },
         }
         i += 1
     if i == 0:
         raise ValueError("no encoder layers found — not a torchvision ViT state_dict")
+    return params, {}
+
+
+def import_torch_convnext(state_dict: Mapping[str, Any]) -> tuple[dict, dict]:
+    """Convert an official-layout ConvNeXt state_dict
+    (facebookresearch/ConvNeXt, also what timm exports:
+    ``downsample_layers.{s}``, ``stages.{s}.{b}.{dwconv,norm,pwconv1,
+    pwconv2,gamma}``, ``norm``, ``head``) to ``(params, model_state)``
+    for ``models.ConvNeXt``.  ``model_state`` is ``{}``.
+    """
+    params: dict = {
+        # downsample_layers.0 = stem: [conv4x4/4, LN]
+        "stem": {
+            "kernel": _conv(state_dict, "downsample_layers.0.0"),
+            "bias": _np(state_dict["downsample_layers.0.0.bias"]),
+        },
+        "stem_norm": _ln(state_dict, "downsample_layers.0.1"),
+        "head_norm": _ln(state_dict, "norm"),
+        "head": _linear(state_dict, "head"),
+    }
+    # downsample_layers.1..3 = [LN, conv2x2/2]
+    s = 1
+    while f"downsample_layers.{s}.1.weight" in state_dict:
+        params[f"down{s}"] = {
+            "norm": _ln(state_dict, f"downsample_layers.{s}.0"),
+            "conv": {
+                "kernel": _conv(state_dict, f"downsample_layers.{s}.1"),
+                "bias": _np(state_dict[f"downsample_layers.{s}.1.bias"]),
+            },
+        }
+        s += 1
+
+    k = 0  # flat block index across stages, matching the flax naming
+    stage = 0
+    while f"stages.{stage}.0.dwconv.weight" in state_dict:
+        b = 0
+        while f"stages.{stage}.{b}.dwconv.weight" in state_dict:
+            t = f"stages.{stage}.{b}"
+            params[f"block{k}"] = {
+                "dwconv": {
+                    "kernel": _conv(state_dict, f"{t}.dwconv"),
+                    "bias": _np(state_dict[f"{t}.dwconv.bias"]),
+                },
+                "norm": _ln(state_dict, f"{t}.norm"),
+                "pwconv1": _linear(state_dict, f"{t}.pwconv1"),
+                "pwconv2": _linear(state_dict, f"{t}.pwconv2"),
+                "layer_scale": _np(state_dict[f"{t}.gamma"]),
+            }
+            k += 1
+            b += 1
+        stage += 1
+    if k == 0:
+        raise ValueError("no stages found — not an official-layout ConvNeXt state_dict")
     return params, {}
 
 
@@ -183,8 +238,8 @@ def load_torch_file(
 ) -> tuple[dict, dict]:
     """Load a .pt/.pth checkpoint file and convert (requires torch).
 
-    ``arch``: ``"resnet"`` (uses ``depth``) or ``"vit"`` (uses
-    ``num_heads``).
+    ``arch``: ``"resnet"`` (uses ``depth``), ``"vit"`` (uses
+    ``num_heads``), or ``"convnext"``.
     """
     import torch
 
@@ -195,4 +250,42 @@ def load_torch_file(
         return import_torch_resnet(obj, depth=depth)
     if arch == "vit":
         return import_torch_vit(obj, num_heads=num_heads)
-    raise ValueError(f"unknown arch {arch!r}; expected 'resnet' or 'vit'")
+    if arch == "convnext":
+        return import_torch_convnext(obj)
+    raise ValueError(
+        f"unknown arch {arch!r}; expected 'resnet', 'vit', or 'convnext'"
+    )
+
+
+def load_torch_weights_for(model_name: str, num_classes: int, path: str):
+    """One-call CLI path: build the torch-compatible model for a factory
+    name (``resnet50``/``vit_b16``/``convnext_base``/…) and load the
+    matching .pt/.pth weights.
+
+    Returns ``(model, variables)`` ready for
+    ``model.apply(variables, x, train=False)``.  ViT/ConvNeXt models are
+    constructed in their torch-compat form (class-token readout / exact
+    GELU) so imported weights are numerically faithful.
+    """
+    from fluxdistributed_tpu import models as m
+
+    factory = getattr(m, model_name, None)
+    if factory is None:
+        raise ValueError(f"unknown model {model_name!r}")
+    if model_name.startswith("resnet") and model_name[6:].isdigit():
+        model = factory(num_classes=num_classes)
+        params, mstate = load_torch_file(path, depth=int(model_name[6:]))
+    elif model_name.startswith("vit_"):
+        model = factory(num_classes=num_classes, use_class_token=True,
+                        gelu_exact=True)
+        params, mstate = load_torch_file(path, arch="vit",
+                                         num_heads=model.num_heads)
+    elif model_name.startswith("convnext_"):
+        model = factory(num_classes=num_classes, gelu_exact=True)
+        params, mstate = load_torch_file(path, arch="convnext")
+    else:
+        raise ValueError(
+            f"--torch-weights supports resnet*/vit_*/convnext_* models, "
+            f"got {model_name!r}"
+        )
+    return model, {"params": params, **mstate}

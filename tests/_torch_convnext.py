@@ -1,0 +1,65 @@
+"""Minimal torch ConvNeXt with official parameter names.
+
+Test fixture only: the ConvNeXt architecture (Liu et al. 2022) with
+exactly the state_dict layout the official facebookresearch/ConvNeXt
+code (and timm) exports — ``downsample_layers.{s}``,
+``stages.{s}.{b}.{dwconv,norm,pwconv1,pwconv2,gamma}``, ``norm``,
+``head`` — consumed by ``models/torch_import.py::import_torch_convnext``.
+Computes in channels-last internally so plain nn.LayerNorm matches the
+official channels_first/last LayerNorm numerics.
+"""
+
+from __future__ import annotations
+
+import torch
+import torch.nn as nn
+
+
+class Block(nn.Module):
+    def __init__(self, dim):
+        super().__init__()
+        self.dwconv = nn.Conv2d(dim, dim, 7, padding=3, groups=dim)
+        self.norm = nn.LayerNorm(dim, eps=1e-6)
+        self.pwconv1 = nn.Linear(dim, 4 * dim)
+        self.act = nn.GELU()
+        self.pwconv2 = nn.Linear(4 * dim, dim)
+        self.gamma = nn.Parameter(1e-6 * torch.ones(dim))
+
+    def forward(self, x):  # x: (N, H, W, C)
+        shortcut = x
+        x = self.dwconv(x.permute(0, 3, 1, 2)).permute(0, 2, 3, 1)
+        x = self.norm(x)
+        x = self.pwconv2(self.act(self.pwconv1(x)))
+        return shortcut + self.gamma * x
+
+
+class TorchConvNeXt(nn.Module):
+    def __init__(self, depths=(1, 1, 2, 1), dims=(16, 32, 64, 128), num_classes=10):
+        super().__init__()
+        self.downsample_layers = nn.ModuleList()
+        self.downsample_layers.append(nn.Sequential(
+            nn.Conv2d(3, dims[0], 4, 4), nn.LayerNorm(dims[0], eps=1e-6),
+        ))
+        for s in range(3):
+            self.downsample_layers.append(nn.Sequential(
+                nn.LayerNorm(dims[s], eps=1e-6),
+                nn.Conv2d(dims[s], dims[s + 1], 2, 2),
+            ))
+        self.stages = nn.ModuleList(
+            nn.Sequential(*[Block(dims[s]) for _ in range(depths[s])])
+            for s in range(4)
+        )
+        self.norm = nn.LayerNorm(dims[-1], eps=1e-6)
+        self.head = nn.Linear(dims[-1], num_classes)
+
+    def forward(self, x):  # x: (N, C, H, W)
+        for s in range(4):
+            if s == 0:
+                x = self.downsample_layers[0][0](x).permute(0, 2, 3, 1)
+                x = self.downsample_layers[0][1](x)
+            else:
+                x = self.downsample_layers[s][0](x)
+                x = self.downsample_layers[s][1](x.permute(0, 3, 1, 2)).permute(0, 2, 3, 1)
+            x = self.stages[s](x)
+        x = self.norm(x.mean(dim=(1, 2)))
+        return self.head(x)

@@ -54,7 +54,7 @@ def test_infer_cli_torch_weights(tmp_path, capsys):
     rc = infer.main(["--model", "resnet18", "--torch-weights", str(pt)])
     assert rc == 0
     out = capsys.readouterr().out
-    assert "loaded torchvision-layout weights" in out
+    assert "loaded torch-layout weights" in out
 
 
 def test_param_tree_shapes_match_init():
@@ -125,6 +125,50 @@ def test_vit_import_tree_matches_init():
     model = ViT(patch=8, depth=2, dim=64, num_heads=4, mlp_dim=128,
                 num_classes=10, dtype=jnp2.float32, use_class_token=True)
     ref = model.init(jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32),
+                     train=False)
+    got = jax.tree.map(np.shape, params)
+    want = jax.tree.map(np.shape, ref["params"])
+    assert got == want
+
+
+def test_convnext_logit_parity():
+    """Official-layout ConvNeXt weights -> flax ConvNeXt, logit parity."""
+    import jax.numpy as jnp3
+
+    from fluxdistributed_tpu.models import convnext_test
+    from fluxdistributed_tpu.models.torch_import import import_torch_convnext
+
+    from _torch_convnext import TorchConvNeXt
+
+    torch.manual_seed(0)
+    tm = TorchConvNeXt(depths=(1, 1, 2, 1), dims=(16, 32, 64, 128),
+                       num_classes=10).eval()
+    params, mstate = import_torch_convnext(tm.state_dict())
+
+    model = convnext_test(num_classes=10, dtype=jnp3.float32, gelu_exact=True)
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (2, 64, 64, 3)).astype(np.float32)
+
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    out = np.asarray(model.apply({"params": params, **mstate}, x, train=False))
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_convnext_import_tree_matches_init():
+    import jax
+    import jax.numpy as jnp3
+
+    from fluxdistributed_tpu.models import convnext_test
+    from fluxdistributed_tpu.models.torch_import import import_torch_convnext
+
+    from _torch_convnext import TorchConvNeXt
+
+    torch.manual_seed(1)
+    tm = TorchConvNeXt(depths=(1, 1, 2, 1), dims=(16, 32, 64, 128), num_classes=10)
+    params, _ = import_torch_convnext(tm.state_dict())
+    model = convnext_test(num_classes=10, dtype=jnp3.float32)
+    ref = model.init(jax.random.PRNGKey(0), np.zeros((1, 64, 64, 3), np.float32),
                      train=False)
     got = jax.tree.map(np.shape, params)
     want = jax.tree.map(np.shape, ref["params"])
