@@ -57,6 +57,8 @@ __all__ = [
     "adam",
     "adamw",
     "lars",
+    "global_norm",
+    "clip_by_global_norm",
     "constant",
     "step_decay",
     "cosine_decay",
@@ -263,6 +265,46 @@ def lars(
         return _map_with_state(step_leaf, params, state, grads)
 
     return Optimizer(init, update, "lars")
+
+
+# ---------------------------------------------------------------------------
+# Gradient transformations
+# ---------------------------------------------------------------------------
+
+
+def global_norm(tree: Pytree):
+    """L2 norm over every non-``None`` leaf of a gradient tree (f32
+    accumulation regardless of leaf dtype)."""
+    leaves = [g for g in jax.tree.leaves(tree, is_leaf=_is_none) if g is not None]
+    if not leaves:
+        return jnp.zeros(())
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    )
+
+
+def clip_by_global_norm(optimizer: Optimizer, max_norm: float) -> Optimizer:
+    """Wrap an optimizer with global-norm gradient clipping (the standard
+    transformer-training guard; ViT/ConvNeXt recipes clip at 1.0).
+
+    Pure and jit-compatible: grads whose global norm exceeds
+    ``max_norm`` are rescaled to exactly ``max_norm`` before the wrapped
+    rule runs; smaller gradients pass through untouched.  ``None``
+    (frozen) leaves are preserved.
+    """
+
+    def update(params, grads, state, step):
+        norm = global_norm(grads)
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+
+        def f(g):
+            return None if g is None else (g * scale).astype(g.dtype)
+
+        return optimizer.update(params, _map(f, grads), state, step)
+
+    return Optimizer(
+        init=optimizer.init, update=update, name=f"clip{max_norm}({optimizer.name})"
+    )
 
 
 # ---------------------------------------------------------------------------

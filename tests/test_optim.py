@@ -107,3 +107,48 @@ def test_optimizer_jits_with_schedule():
     for i in range(4):
         p, st = step(p, g, st, jnp.asarray(i))
     assert np.all(np.isfinite(np.asarray(p["w"])))
+
+
+def test_clip_by_global_norm():
+    from fluxdistributed_tpu.optim import clip_by_global_norm, descent, global_norm
+
+    params = {"a": jnp.zeros((3,)), "b": jnp.zeros((2,)), "frozen": jnp.zeros(())}
+    grads = {"a": jnp.asarray([3.0, 0.0, 0.0]), "b": jnp.asarray([0.0, 4.0]),
+             "frozen": None}
+    assert float(global_norm(grads)) == 5.0
+
+    opt = clip_by_global_norm(descent(1.0), max_norm=1.0)
+    st = opt.init(params)
+    new_params, _ = jax.jit(opt.apply)(params, grads, st, 0)
+    # effective grad rescaled to norm exactly 1 -> update = -g/5
+    np.testing.assert_allclose(np.asarray(new_params["a"]), [-0.6, 0, 0], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_params["b"]), [0, -0.8], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_params["frozen"]), 0.0)
+
+    # below the threshold: untouched
+    opt2 = clip_by_global_norm(descent(1.0), max_norm=10.0)
+    p2, _ = opt2.apply(params, grads, opt2.init(params), 0)
+    np.testing.assert_allclose(np.asarray(p2["a"]), [-3.0, 0, 0], rtol=1e-6)
+
+
+def test_clip_in_compiled_train_step():
+    """Clipping composes with the compiled DP step."""
+    import fluxdistributed_tpu as fd
+    from fluxdistributed_tpu import optim, sharding
+    from fluxdistributed_tpu.models import SimpleCNN
+    from fluxdistributed_tpu.parallel import TrainState, make_train_step
+    from fluxdistributed_tpu.parallel.dp import flax_loss_fn
+
+    mesh = fd.data_mesh()
+    model = SimpleCNN(num_classes=10)
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (16, 16, 16, 3)).astype(np.float32)
+    y = np.asarray(fd.onehot(rng.integers(0, 10, 16), 10))
+    variables = model.init(jax.random.PRNGKey(0), x[:1], train=False)
+    loss_fn = flax_loss_fn(model, fd.logitcrossentropy, has_aux_state=False)
+    opt = optim.clip_by_global_norm(optim.momentum(0.1, 0.9), 1.0)
+    step = make_train_step(loss_fn, opt, mesh, donate=False)
+    state = TrainState.create(sharding.replicate(variables["params"], mesh), opt)
+    b = sharding.shard_batch({"image": x, "label": y}, mesh)
+    state, m = step(state, b)
+    assert int(state.step) == 1 and float(m["loss"]) > 0
