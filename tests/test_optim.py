@@ -152,3 +152,53 @@ def test_clip_in_compiled_train_step():
     b = sharding.shard_batch({"image": x, "label": y}, mesh)
     state, m = step(state, b)
     assert int(state.step) == 1 and float(m["loss"]) > 0
+
+
+def test_ema_tracks_params():
+    from fluxdistributed_tpu.optim import descent, ema_params, with_ema
+
+    opt = with_ema(descent(0.5), decay=0.9)
+    params = {"w": jnp.asarray([1.0, 2.0])}
+    st = opt.init(params)
+    np.testing.assert_array_equal(np.asarray(ema_params(st)["w"]), [1.0, 2.0])
+
+    g = {"w": jnp.asarray([1.0, 1.0])}
+    p1, st = opt.apply(params, g, st, 0)  # params -> [0.5, 1.5]
+    # warmup-corrected decay at t=0: min(0.9, 1/10) = 0.1
+    want = 0.1 * np.asarray([1.0, 2.0]) + 0.9 * np.asarray(p1["w"])
+    np.testing.assert_allclose(np.asarray(ema_params(st)["w"]), want, rtol=1e-6)
+
+    # late steps use the configured decay
+    p2, st2 = opt.apply(p1, g, st, 1000)
+    want2 = 0.9 * np.asarray(ema_params(st)["w"]) + 0.1 * np.asarray(p2["w"])
+    np.testing.assert_allclose(np.asarray(ema_params(st2)["w"]), want2, rtol=1e-6)
+
+    with pytest.raises(ValueError, match="EMA"):
+        ema_params({"not": "ema"})
+
+
+def test_ema_in_compiled_train_step():
+    """EMA params converge toward trained params through the DP step."""
+    import fluxdistributed_tpu as fd
+    from fluxdistributed_tpu import optim, sharding
+    from fluxdistributed_tpu.models import SimpleCNN
+    from fluxdistributed_tpu.parallel import TrainState, make_train_step
+    from fluxdistributed_tpu.parallel.dp import flax_loss_fn
+
+    mesh = fd.data_mesh()
+    model = SimpleCNN(num_classes=10)
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (16, 16, 16, 3)).astype(np.float32)
+    y = np.asarray(fd.onehot(rng.integers(0, 10, 16), 10))
+    variables = model.init(jax.random.PRNGKey(0), x[:1], train=False)
+    loss_fn = flax_loss_fn(model, fd.logitcrossentropy, has_aux_state=False)
+    opt = optim.with_ema(optim.momentum(0.1, 0.9), decay=0.5)
+    step = make_train_step(loss_fn, opt, mesh, donate=False)
+    state = TrainState.create(sharding.replicate(variables["params"], mesh), opt)
+    b = sharding.shard_batch({"image": x, "label": y}, mesh)
+    for _ in range(20):
+        state, _ = step(state, b)
+    ema = optim.ema_params(state.opt_state)
+    # after 20 steps at decay .5 the shadow is close to the live params
+    for e, p in zip(jax.tree.leaves(ema), jax.tree.leaves(state.params)):
+        assert np.abs(np.asarray(e) - np.asarray(p)).max() < 0.5
