@@ -31,6 +31,10 @@ where the reference uses them, standard forms otherwise):
                              (the ConvNeXt-XL large-batch config in
                              BASELINE.json)
 
+Gradient/parameter transformations (wrap any optimizer):
+``clip_by_global_norm(opt, max_norm)`` and ``with_ema(opt, decay)`` /
+``ema_params(state)``.
+
 Schedules (callables ``step -> lr``, usable anywhere ``lr`` is accepted):
 ``constant``, ``step_decay``, ``cosine_decay``, ``warmup_cosine``.
 ``step_decay(lr0, 0.2, 10)`` reproduces the reference's legacy LR/5 every
