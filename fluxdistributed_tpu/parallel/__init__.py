@@ -7,6 +7,8 @@ from .context import (
     ulysses_attention,
 )
 from .dp import TrainState, make_train_step, make_eval_step, make_train_step_shardmap
+from . import fsdp
+from .fsdp import fsdp_specs, make_train_step_fsdp, make_eval_step_fsdp
 from .ep import (
     moe_apply,
     router_dispatch,
@@ -27,6 +29,10 @@ __all__ = [
     "make_train_step",
     "make_eval_step",
     "make_train_step_shardmap",
+    "fsdp",
+    "fsdp_specs",
+    "make_train_step_fsdp",
+    "make_eval_step_fsdp",
     "ring_attention",
     "make_ring_attention",
     "ulysses_attention",

@@ -124,6 +124,7 @@ def make_train_step(
     donate: bool = True,
     accum_steps: int = 1,
     seed: int = 0,
+    state_shardings=None,
 ):
     """Compile the full DP training step under ``jit`` + shardings.
 
@@ -131,6 +132,13 @@ def make_train_step(
     arrays are sharded on ``axis`` and ``state`` is replicated.  The
     gradient all-reduce is implicit in differentiating the global-batch
     mean loss.
+
+    ``state_shardings`` (a ``TrainState`` of ``NamedSharding`` leaves)
+    overrides the replicated default for the train state — this is how
+    ``fsdp.make_train_step_fsdp`` turns the same step into ZeRO-style
+    fully-sharded data parallelism without duplicating the step logic:
+    XLA inserts the all-gathers (params on use) and reduce-scatters
+    (grads at the sharded update) implied by the annotations.
 
     ``accum_steps > 1`` enables gradient accumulation (beyond the
     reference, which has no analog): the batch's leading dim is split
@@ -146,6 +154,7 @@ def make_train_step(
     """
     repl = NamedSharding(mesh, P())
     shard = NamedSharding(mesh, P(axis))
+    state_sh = repl if state_shardings is None else state_shardings
     with_rng = _accepts_rng(loss_fn)
 
     def grad_of(params, mstate, batch, step_idx):
@@ -198,8 +207,8 @@ def make_train_step(
 
     return jax.jit(
         step,
-        in_shardings=(repl, shard),
-        out_shardings=(repl, repl),
+        in_shardings=(state_sh, shard),
+        out_shardings=(state_sh, repl),
         donate_argnums=(0,) if donate else (),
     )
 
@@ -209,6 +218,7 @@ def make_eval_step(
     mesh: Mesh,
     axis: str = mesh_lib.DATA_AXIS,
     topk: tuple = (1, 5, 10),
+    state_shardings=None,
 ):
     """Compiled eval pass returning ``(loss, metrics)``.
 
@@ -224,6 +234,7 @@ def make_eval_step(
 
     repl = NamedSharding(mesh, P())
     shard = NamedSharding(mesh, P(axis))
+    state_sh = repl if state_shardings is None else state_shardings
 
     def step(state: TrainState, batch):
         loss, (_, logits) = loss_fn(state.params, state.model_state, batch, False)
@@ -232,7 +243,7 @@ def make_eval_step(
         }
         return loss, metrics
 
-    return jax.jit(step, in_shardings=(repl, shard), out_shardings=(repl, repl))
+    return jax.jit(step, in_shardings=(state_sh, shard), out_shardings=(repl, repl))
 
 
 def make_train_step_shardmap(

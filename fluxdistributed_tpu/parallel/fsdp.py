@@ -1,0 +1,176 @@
+"""Fully-sharded data parallelism (ZeRO-3 style) via GSPMD annotations.
+
+Scope beyond the reference: its DDP keeps a full model + optimizer copy
+per device (replica replication, src/ddp_tasks.jl:273-276), so the
+largest trainable model is bounded by ONE device's memory.  FSDP removes
+that bound the TPU-native way — not by hand-written bucketed all-gathers
+(the torch FSDP/DeepSpeed approach), but by *annotation*: every
+parameter and optimizer-state leaf is sharded across the ``data`` axis,
+and the train step is the UNCHANGED DP step (``dp.make_train_step``)
+compiled with those shardings.  XLA's SPMD partitioner then inserts
+
+* an all-gather per layer when the forward/backward needs the full
+  parameter (overlapped with compute by the latency-hiding scheduler),
+* a reduce-scatter for the gradient at the sharded optimizer update
+  (replacing DP's all-reduce, at half the bytes on the wire),
+
+which is exactly the ZeRO-3 communication schedule, derived by the
+compiler instead of scheduled by hand.
+
+Per-device memory for params + optimizer state drops ~N× on an N-way
+mesh (verified by ``tests/test_fsdp.py`` via ``addressable_shards``);
+numerics match the DP step's up to float reduction order — the
+annotations change where sums happen (reduce-scatter vs all-reduce),
+not the math, and ``tests/test_fsdp.py`` asserts agreement to ~1e-5
+over multiple optimizer steps.
+
+Usage::
+
+    specs  = fsdp_specs(state, mesh)              # TrainState of PartitionSpecs
+    state  = shard_state(state, specs, mesh)      # place shards
+    step   = make_train_step_fsdp(loss_fn, opt, mesh, specs)
+
+CPU-emulation caveat: on a ``--xla_force_host_platform_device_count``
+fake mesh, XLA:CPU runs each device as a thread-pool thread and its
+in-process cross-module collectives (the per-layer all-gathers this
+schedule introduces) can deadlock when several *donated* steps are in
+flight at once — threads from different executions join the same
+rendezvous.  Synchronize per step (``jax.block_until_ready``) or pass
+``donate=False`` when driving FSDP on the CPU mesh; real TPUs execute
+programs in per-device FIFO order and are unaffected.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import mesh as mesh_lib
+from ..optim import Optimizer
+from . import dp
+
+__all__ = [
+    "fsdp_leaf_spec",
+    "fsdp_specs",
+    "shard_state",
+    "make_train_step_fsdp",
+    "make_eval_step_fsdp",
+]
+
+# Leaves smaller than this stay replicated: sharding a 64-float BatchNorm
+# bias saves nothing and costs a latency-bound collective per use.
+MIN_SHARD_ELEMS = 2**11
+
+
+def fsdp_leaf_spec(
+    shape, axis: str = mesh_lib.DATA_AXIS, nshards: int = 1,
+    min_size: int = MIN_SHARD_ELEMS,
+) -> P:
+    """PartitionSpec for one leaf, chosen from its shape alone.
+
+    Shards the largest dimension divisible by ``nshards`` (ties broken
+    toward the trailing dim — for conv HWIO / dense (in, out) kernels
+    that is the output-features dim, giving contiguous lanes-friendly
+    shards).  Leaves with fewer than ``min_size`` elements, or no
+    divisible dim, stay replicated.
+
+    The rule is a pure function of shape, so a parameter and its
+    optimizer-state slots (momentum/Adam moments have the param's shape)
+    always agree — the property that lets one spec tree cover the whole
+    ``TrainState``.
+    """
+    if not shape or int(np.prod(shape)) < min_size:
+        return P()
+    best = None  # (extent, dim)
+    for d, extent in enumerate(shape):
+        if extent % nshards == 0 and extent >= nshards:
+            if best is None or extent >= best[0]:
+                best = (extent, d)
+    if best is None:
+        return P()
+    spec = [None] * len(shape)
+    spec[best[1]] = axis
+    return P(*spec)
+
+
+def fsdp_specs(
+    state: dp.TrainState,
+    mesh: Mesh,
+    axis: str = mesh_lib.DATA_AXIS,
+    min_size: int = MIN_SHARD_ELEMS,
+) -> dp.TrainState:
+    """A ``TrainState`` of PartitionSpecs: params and optimizer state
+    sharded by :func:`fsdp_leaf_spec`; mutable model state (BatchNorm
+    running stats — small, and updated from *activation* statistics, not
+    gradients) and the step counter replicated."""
+    n = mesh.shape[axis]
+
+    def leaf(x):
+        return fsdp_leaf_spec(np.shape(x), axis, n, min_size)
+
+    return dp.TrainState(
+        params=jax.tree.map(leaf, state.params),
+        opt_state=jax.tree.map(leaf, state.opt_state),
+        model_state=jax.tree.map(lambda _: P(), state.model_state),
+        step=P(),
+    )
+
+
+def _shardings(specs: dp.TrainState, mesh: Mesh) -> dp.TrainState:
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_state(state: dp.TrainState, specs: dp.TrainState, mesh: Mesh) -> dp.TrainState:
+    """Place each state leaf according to its spec (shards distributed
+    across the mesh; replicated leaves copied everywhere)."""
+    from ..sharding import unaliased
+
+    return jax.tree.map(
+        lambda x, s: jax.device_put(unaliased(x), s), state, _shardings(specs, mesh)
+    )
+
+
+def make_train_step_fsdp(
+    loss_fn: Callable,
+    optimizer: Optimizer,
+    mesh: Mesh,
+    specs: dp.TrainState,
+    axis: str = mesh_lib.DATA_AXIS,
+    donate: bool = True,
+    accum_steps: int = 1,
+    seed: int = 0,
+):
+    """The DP train step compiled with fully-sharded state.
+
+    Identical math to ``dp.make_train_step`` (same loss, same implicit
+    gradient reduction, same optimizer) — only the state's shardings
+    differ, so the compiler emits the ZeRO-3 schedule described in the
+    module docstring.  ``batch`` stays sharded on ``axis`` exactly as in
+    DP.
+    """
+    return dp.make_train_step(
+        loss_fn, optimizer, mesh,
+        axis=axis, donate=donate, accum_steps=accum_steps, seed=seed,
+        state_shardings=_shardings(specs, mesh),
+    )
+
+
+def make_eval_step_fsdp(
+    loss_fn: Callable,
+    mesh: Mesh,
+    specs: dp.TrainState,
+    axis: str = mesh_lib.DATA_AXIS,
+    topk: tuple = (1, 5, 10),
+):
+    """Eval pass accepting the FSDP-sharded state directly (no gather to
+    host, no resharding round-trip)."""
+    return dp.make_eval_step(
+        loss_fn, mesh, axis=axis, topk=topk,
+        state_shardings=_shardings(specs, mesh),
+    )

@@ -1,0 +1,139 @@
+"""FSDP (ZeRO-3 via GSPMD) invariants, on the 8-device mesh.
+
+Sharding annotations must never change the math: the FSDP step's params
+after N steps must match the replicated DP step's bit-for-bit behavior
+(same tolerance as the DP-vs-single-device invariant the reference
+asserts, test/single_device.jl:153-166).  And the point of FSDP — the
+memory win — is asserted directly: each device holds ~1/8th of every
+large leaf (``addressable_shards``), not a full copy.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from fluxdistributed_tpu import optim, sharding
+from fluxdistributed_tpu.models import SimpleCNN
+from fluxdistributed_tpu.ops import logitcrossentropy
+from fluxdistributed_tpu.parallel import (
+    TrainState,
+    fsdp,
+    fsdp_specs,
+    make_eval_step_fsdp,
+    make_train_step,
+    make_train_step_fsdp,
+)
+from fluxdistributed_tpu.parallel.dp import flax_loss_fn
+
+BATCH = 32
+NCLASS = 10
+
+
+@pytest.fixture(scope="module")
+def setup():
+    import fluxdistributed_tpu.mesh as mesh_lib
+
+    mesh = mesh_lib.data_mesh(8)
+    model = SimpleCNN(num_classes=NCLASS)
+    x = jax.random.normal(jax.random.PRNGKey(1), (BATCH, 8, 8, 3), jnp.float32)
+    y = jax.nn.one_hot(
+        jax.random.randint(jax.random.PRNGKey(2), (BATCH,), 0, NCLASS), NCLASS
+    )
+    params = model.init(jax.random.PRNGKey(0), x[:2], train=True)["params"]
+    loss_fn = flax_loss_fn(model, logitcrossentropy)
+    return mesh, params, loss_fn, {"image": x, "label": y}
+
+
+def test_leaf_spec_rule():
+    # large 2D leaf: shard the larger dim; trailing wins ties
+    assert fsdp.fsdp_leaf_spec((4096, 512), "data", 8) == P("data", None)
+    assert fsdp.fsdp_leaf_spec((512, 4096), "data", 8) == P(None, "data")
+    assert fsdp.fsdp_leaf_spec((4096, 4096), "data", 8) == P(None, "data")
+    # conv HWIO: features dim, not the 3x3 window
+    assert fsdp.fsdp_leaf_spec((3, 3, 256, 256), "data", 8) == P(
+        None, None, None, "data"
+    )
+    # small leaves (BN scale etc.) stay replicated
+    assert fsdp.fsdp_leaf_spec((64,), "data", 8) == P()
+    # no divisible dim -> replicated
+    assert fsdp.fsdp_leaf_spec((63, 65), "data", 8, min_size=1) == P()
+    # scalars
+    assert fsdp.fsdp_leaf_spec((), "data", 8) == P()
+
+
+def test_fsdp_matches_dp(setup):
+    mesh, params, loss_fn, batch = setup
+    opt = optim.momentum(0.05, 0.9)
+    b = sharding.shard_batch(batch, mesh)
+
+    # replicated DP ground truth
+    dp_state = TrainState.create(sharding.replicate(params, mesh), opt)
+    dp_step = make_train_step(loss_fn, opt, mesh, donate=False)
+
+    # FSDP: same initial params, sharded state
+    fs_state = TrainState.create(params, opt)
+    specs = fsdp_specs(fs_state, mesh, min_size=64)  # small model: force sharding
+    fs_state = fsdp.shard_state(fs_state, specs, mesh)
+    fs_step = make_train_step_fsdp(loss_fn, opt, mesh, specs, donate=False)
+
+    for _ in range(3):
+        dp_state, dp_m = dp_step(dp_state, b)
+        fs_state, fs_m = fs_step(fs_state, b)
+        np.testing.assert_allclose(
+            np.asarray(dp_m["loss"]), np.asarray(fs_m["loss"]), rtol=1e-6
+        )
+
+    for (pa, a), (pb, bb) in zip(
+        jax.tree_util.tree_leaves_with_path(dp_state.params),
+        jax.tree_util.tree_leaves_with_path(fs_state.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(bb), rtol=2e-5, atol=1e-6,
+            err_msg=f"param mismatch at {jax.tree_util.keystr(pa)}",
+        )
+
+
+def test_fsdp_shards_memory(setup):
+    mesh, params, loss_fn, batch = setup
+    opt = optim.adam(1e-3)
+    state = TrainState.create(params, opt)
+    specs = fsdp_specs(state, mesh, min_size=64)
+    state = fsdp.shard_state(state, specs, mesh)
+
+    n = mesh.shape["data"]
+    sharded = 0
+    for spec, leaf in zip(
+        jax.tree.leaves(specs.params, is_leaf=lambda x: isinstance(x, P)),
+        jax.tree.leaves(state.params),
+    ):
+        shard = leaf.addressable_shards[0].data
+        if spec != P():
+            assert shard.size == leaf.size // n, (spec, leaf.shape, shard.shape)
+            sharded += 1
+        else:
+            assert shard.size == leaf.size
+    assert sharded > 0, "no leaf was sharded — rule or model shapes changed"
+    # optimizer moments follow the same rule (same shapes), incl. adam's
+    for leaf in jax.tree.leaves(state.opt_state):
+        assert leaf.addressable_shards[0].data.size <= leaf.size
+
+
+def test_fsdp_eval_and_accum(setup):
+    mesh, params, loss_fn, batch = setup
+    opt = optim.momentum(0.05, 0.9)
+    b = sharding.shard_batch(batch, mesh)
+    state = TrainState.create(params, opt)
+    specs = fsdp_specs(state, mesh, min_size=64)
+    state = fsdp.shard_state(state, specs, mesh)
+
+    # grad accumulation composes with FSDP (scan over microbatches)
+    step = make_train_step_fsdp(loss_fn, opt, mesh, specs, donate=False, accum_steps=2)
+    state2, m = step(state, b)
+    assert np.isfinite(np.asarray(m["loss"]))
+
+    ev = make_eval_step_fsdp(loss_fn, mesh, specs, topk=(1,))
+    loss, metrics = ev(state2, b)
+    assert np.isfinite(np.asarray(loss))
+    assert 0.0 <= float(metrics["top1"]) <= 1.0
