@@ -62,7 +62,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="resume from latest checkpoint in --checkpoint-dir")
     p.add_argument("--print-every", type=int, default=10)
     p.add_argument("--eval-every", type=int, default=50)
-    p.add_argument("--spmd", default="jit", choices=["jit", "shard_map"])
+    p.add_argument("--spmd", default="jit", choices=["jit", "shard_map", "fsdp"])
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--wandb", action="store_true", help="log to Weights & Biases")
     # manual cluster bring-up (CPU fake cluster / debugging)

@@ -87,8 +87,12 @@ def load_checkpoint(
     host arrays) with no structure requirements — useful when the saving
     optimizer is unknown (e.g. inference tools that only need
     ``restored["params"]``).  ``step=None`` picks the latest (resume
-    semantics).  With ``mesh`` given, restored arrays are placed
-    replicated on the mesh, ready to hand back to a compiled train step.
+    semantics).  With ``mesh`` given, restored arrays are placed on the
+    mesh ready to hand back to a compiled train step: each leaf takes its
+    ``target`` leaf's sharding when the target is device-placed (so an
+    FSDP-sharded state restores sharded, not gathered), else replicated.
+    Restore is topology-independent either way — the placement comes from
+    the *restoring* target/mesh, never from the saved run's devices.
     """
     if step is None:
         step = latest_step(directory)
@@ -107,12 +111,33 @@ def load_checkpoint(
             meta,
         )
         restored = ckptr.restore(path, target=target)
-    else:
-        restored = ckptr.restore(
-            path, target=jax.tree.map(np.asarray, tree_lib.to_host(target))
-        )
-    if mesh is not None:
-        from ..sharding import replicate
+        if mesh is not None:
+            from ..sharding import replicate
 
-        restored = replicate(restored, mesh)
-    return restored
+            restored = replicate(restored, mesh)
+        return restored
+
+    if mesh is not None:
+        # Restore straight into device-sharded arrays via an ABSTRACT
+        # target carrying each target leaf's sharding (its own when
+        # device-placed — so FSDP/TP state restores sharded — else
+        # replicated).  No host round-trip: to_host on a sharded state
+        # would both re-materialize the full model per host (undoing the
+        # FSDP memory bound at resume time) and crash outright on
+        # multi-host leaves that span non-addressable devices.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        repl = NamedSharding(mesh, PartitionSpec())
+
+        def abstract(t):
+            if hasattr(t, "shape") and hasattr(t, "dtype"):
+                sh = getattr(t, "sharding", None)
+                sh = sh if isinstance(sh, NamedSharding) else repl
+                return jax.ShapeDtypeStruct(np.shape(t), t.dtype, sharding=sh)
+            return t
+
+        return ckptr.restore(path, target=jax.tree.map(abstract, target))
+
+    return ckptr.restore(
+        path, target=jax.tree.map(np.asarray, tree_lib.to_host(target))
+    )

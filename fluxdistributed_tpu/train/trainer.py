@@ -92,7 +92,10 @@ def prepare_training(
 
     ``val_samples`` defaults to the reference's 300-sample val slice
     (src/ddp_tasks.jl:145).  ``spmd`` selects the compiled path: ``"jit"``
-    (auto-sharded) or ``"shard_map"`` (explicit collectives).
+    (auto-sharded DP), ``"shard_map"`` (explicit collectives), or
+    ``"fsdp"`` (ZeRO-3: params + optimizer state sharded across the data
+    axis, see ``parallel/fsdp.py`` — same step math, ~N× lower state
+    memory on an N-way mesh).
 
     ``donate=True`` donates the TrainState buffers to each step (halves
     peak state memory — worthwhile for very large models) but is
@@ -115,23 +118,36 @@ def prepare_training(
     model_state = {k: v for k, v in variables.items() if k != "params"}  # e.g. batch_stats
 
     loss_fn = flax_loss_fn(model, loss)
-    if spmd == "shard_map":
-        if accum_steps != 1:
-            raise ValueError("accum_steps > 1 requires spmd='jit'")
-        from ..parallel.dp import make_train_step_shardmap as maker
+    if spmd == "fsdp":
+        from ..parallel import fsdp as fsdp_lib
 
-        step_fn = maker(loss_fn, optimizer, mesh, donate=donate, seed=seed)
-    else:
-        step_fn = make_train_step(
-            loss_fn, optimizer, mesh, donate=donate, accum_steps=accum_steps, seed=seed
+        state = TrainState.create(params, optimizer, model_state=model_state)
+        specs = fsdp_lib.fsdp_specs(state, mesh)
+        state = fsdp_lib.shard_state(state, specs, mesh)
+        step_fn = fsdp_lib.make_train_step_fsdp(
+            loss_fn, optimizer, mesh, specs,
+            donate=donate, accum_steps=accum_steps, seed=seed,
         )
-    eval_fn = make_eval_step(loss_fn, mesh, topk=tuple(topk))
+        eval_fn = fsdp_lib.make_eval_step_fsdp(loss_fn, mesh, specs, topk=tuple(topk))
+    else:
+        if spmd == "shard_map":
+            if accum_steps != 1:
+                raise ValueError("accum_steps > 1 requires spmd='jit'")
+            from ..parallel.dp import make_train_step_shardmap as maker
 
-    state = TrainState.create(
-        sharding_lib.replicate(params, mesh),
-        optimizer,
-        model_state=sharding_lib.replicate(model_state, mesh),
-    )
+            step_fn = maker(loss_fn, optimizer, mesh, donate=donate, seed=seed)
+        else:
+            step_fn = make_train_step(
+                loss_fn, optimizer, mesh,
+                donate=donate, accum_steps=accum_steps, seed=seed,
+            )
+        eval_fn = make_eval_step(loss_fn, mesh, topk=tuple(topk))
+
+        state = TrainState.create(
+            sharding_lib.replicate(params, mesh),
+            optimizer,
+            model_state=sharding_lib.replicate(model_state, mesh),
+        )
 
     loader = PrefetchLoader(
         dataset,

@@ -266,11 +266,21 @@ def to_host(tree: Pytree) -> Pytree:
     Analog of the reference returning ``cpu(m)`` replicas at the end of
     ``train`` (src/ddp_tasks.jl:241-246).
     """
-    return jax.tree.map(
-        lambda x: None if x is None else np.asarray(jax.device_get(x)),
-        tree,
-        is_leaf=_is_none,
-    )
+    def f(x):
+        if x is None:
+            return None
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            # A cross-process-sharded leaf (multi-host FSDP/TP state):
+            # device_get cannot fetch non-addressable shards, so gather
+            # the global value collectively.  Every process must reach
+            # this point (to_host is already documented as a host-side
+            # export, called uniformly at the end of train()).
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree.map(f, tree, is_leaf=_is_none)
 
 
 def synchronize(tree: Pytree) -> Pytree:
