@@ -4,7 +4,7 @@ from .loader import PrefetchLoader
 from .preprocess import preprocess
 from .registry import load_registry, open_dataset, register_dataset
 from .sources import FileSource, GCSSource, HTTPSource, make_source
-from .synthetic import SyntheticDataset
+from .synthetic import SyntheticDataset, SyntheticTextDataset
 
 __all__ = [
     "CIFAR10Dataset",
@@ -23,6 +23,7 @@ __all__ = [
     "GCSSource",
     "make_source",
     "SyntheticDataset",
+    "SyntheticTextDataset",
     "minibatch",
 ]
 

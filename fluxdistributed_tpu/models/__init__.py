@@ -15,6 +15,14 @@ from .torch_import import (
     load_torch_file,
 )
 from .simple import SimpleCNN, MLP
+from .transformer_lm import (
+    TransformerLM,
+    lm_loss_fn,
+    lm_medium,
+    lm_small,
+    lm_tiny,
+    next_token_loss,
+)
 from .vit import ViT, vit_tiny, vit_b16, vit_l16, vit_h14
 
 __all__ = [
@@ -37,6 +45,12 @@ __all__ = [
     "load_torch_file",
     "SimpleCNN",
     "MLP",
+    "TransformerLM",
+    "lm_loss_fn",
+    "lm_tiny",
+    "lm_small",
+    "lm_medium",
+    "next_token_loss",
     "ViT",
     "vit_tiny",
     "vit_b16",

@@ -1,0 +1,224 @@
+"""Decoder-only transformer language model (GPT-style).
+
+Scope beyond the reference (vision-only — ResNet on ImageNet,
+src/ddp_tasks.jl:275): this family exists to make the framework's
+long-context machinery first-class on a model that actually has a long
+sequence axis.  The design choices are TPU-first:
+
+* **Pluggable core attention** (the ViT pattern, models/vit.py): pass
+  ``attn_fn=make_ring_attention(mesh, causal=True)`` and the SAME module
+  trains sequence-parallel over a ``seq`` mesh axis, or
+  ``ops.pallas_attention.flash_attention`` for the fused kernel — the
+  default is the XLA-fused ``dot_product_attention(causal=True)``.
+* **RoPE positions** computed on the global token axis — applied before
+  the attention call, so under GSPMD sequence sharding every shard still
+  rotates by its true global position (no per-shard offset bookkeeping).
+* **Pre-LN blocks, bf16 compute, f32 logits** — the residual stream and
+  softmax/CE stay accurate while matmuls ride the MXU in bf16.
+* **Tied input/output embeddings** by default (halves embedding memory —
+  the vocab table is usually the largest single tensor at small scale).
+
+``lm_loss_fn`` adapts the model to the framework's loss signature, so
+every training path — DP (``make_train_step``), FSDP, TP, SP — applies
+unchanged: the batch is ``{"tokens": int32 [B, T]}`` and the loss is
+next-token cross-entropy.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.attention import dot_product_attention
+
+__all__ = [
+    "TransformerLM",
+    "lm_loss_fn",
+    "next_token_loss",
+    "rope",
+    "lm_tiny",
+    "lm_small",
+    "lm_medium",
+]
+
+AttnFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
+
+
+def rope(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding on ``x``: [B, T, H, D] with D even.
+
+    ``positions``: [T] (or [B, T]) global token indices.  Pairs feature
+    ``2i`` with ``2i+1`` and rotates by ``pos / base^(2i/D)`` — relative
+    offsets become phase differences, so attention scores depend only on
+    key/query distance.  Computed in f32 and cast back (bf16 phase
+    accumulation loses precision at long context).
+    """
+    d = x.shape[-1]
+    assert d % 2 == 0, "rope needs an even head dim"
+    inv_freq = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., T, D/2]
+    # broadcast over batch/head axes: positions [T] -> [1, T, 1, D/2]
+    while ang.ndim < x.ndim:
+        ang = ang[None] if ang.ndim < x.ndim - 1 else ang[..., None, :]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., 0::2], x32[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+class CausalSelfAttention(nn.Module):
+    """QKV projection + RoPE + pluggable causal core + output projection."""
+
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    attn_fn: Optional[AttnFn] = None
+    use_rope: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        assert d % self.num_heads == 0, "embed dim must divide num_heads"
+        head_dim = d // self.num_heads
+        qkv = nn.DenseGeneral(
+            (3, self.num_heads, head_dim), axis=-1, dtype=self.dtype, name="qkv"
+        )(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if self.use_rope:
+            pos = jnp.arange(t)
+            q, k = rope(q, pos), rope(k, pos)
+        attn = (
+            self.attn_fn
+            if self.attn_fn is not None
+            else partial(dot_product_attention, causal=True)
+        )
+        out = attn(q, k, v)  # [B, T, H, Dh]
+        return nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype, name="out")(out)
+
+
+class DecoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.0
+    attn_fn: Optional[AttnFn] = None
+    use_rope: bool = True
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = CausalSelfAttention(
+            self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn,
+            use_rope=self.use_rope,
+        )(y)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        d = x.shape[-1]
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype)(y)
+        y = nn.gelu(y, approximate=True)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        y = nn.Dense(d, dtype=self.dtype)(y)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        return x + y
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM: tokens [B, T] int32 → logits [B, T, vocab] f32.
+
+    Position t's logits predict token t+1 (standard autoregressive
+    convention; ``next_token_loss`` does the shift).  With
+    ``tie_embeddings`` the output head reuses the input table
+    (logits = h @ E^T).
+    """
+
+    vocab: int
+    depth: int = 4
+    dim: int = 256
+    num_heads: int = 4
+    mlp_dim: int = 1024
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.0
+    attn_fn: Optional[AttnFn] = None
+    use_rope: bool = True
+    tie_embeddings: bool = True
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        embed = nn.Embed(self.vocab, self.dim, dtype=self.dtype, name="embed")
+        x = embed(tokens)
+        if not self.use_rope:
+            t = tokens.shape[-1]
+            pos_tab = self.param(
+                "pos_embedding", nn.initializers.normal(0.02), (t, self.dim)
+            )
+            x = x + jnp.asarray(pos_tab, self.dtype)[None]
+        for i in range(self.depth):
+            x = DecoderBlock(
+                self.num_heads, self.mlp_dim, dtype=self.dtype,
+                dropout=self.dropout, attn_fn=self.attn_fn,
+                use_rope=self.use_rope, name=f"block{i}",
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype, name="final_ln")(x)
+        if self.tie_embeddings:
+            logits = embed.attend(x)  # h @ E^T
+        else:
+            logits = nn.Dense(self.vocab, dtype=self.dtype, name="head")(x)
+        return jnp.asarray(logits, jnp.float32)
+
+
+def next_token_loss(logits, tokens, mask=None):
+    """Mean next-token cross-entropy.
+
+    ``logits`` [B, T, V] (position t predicts token t+1), ``tokens``
+    [B, T] int; ``mask`` optional [B, T] (True = count this *target*
+    position).  f32 log-softmax regardless of model compute dtype.
+    """
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]  # [B, T-1]
+    if mask is not None:
+        m = mask[:, 1:].astype(nll.dtype)
+        return (nll * m).sum() / jnp.maximum(m.sum(), 1)
+    return nll.mean()
+
+
+def lm_loss_fn(model: TransformerLM) -> Callable:
+    """Adapt the LM to the framework loss signature
+    (``fn(params, model_state, batch, train, rng=None)``) so every
+    compiled step maker — DP/FSDP/TP — accepts it unchanged.  The batch
+    is ``{"tokens": [B, T]}`` with optional ``{"mask": [B, T]}``."""
+
+    def fn(params, model_state, batch, train: bool, rng=None):
+        rngs = {"dropout": rng} if (train and rng is not None) else None
+        logits = model.apply(
+            {"params": params}, batch["tokens"], train=train, rngs=rngs
+        )
+        return next_token_loss(logits, batch["tokens"], batch.get("mask")), (
+            model_state,
+            logits,
+        )
+
+    return fn
+
+
+def lm_tiny(vocab: int = 256, **kw) -> TransformerLM:
+    """Test/CI scale: 4 layers, d=128."""
+    kw = {"depth": 4, "dim": 128, "num_heads": 4, "mlp_dim": 512, **kw}
+    return TransformerLM(vocab=vocab, **kw)
+
+
+def lm_small(vocab: int = 32000, **kw) -> TransformerLM:
+    """GPT-2-small scale: 12 layers, d=768 (~124M with a 32k vocab)."""
+    kw = {"depth": 12, "dim": 768, "num_heads": 12, "mlp_dim": 3072, **kw}
+    return TransformerLM(vocab=vocab, **kw)
+
+
+def lm_medium(vocab: int = 32000, **kw) -> TransformerLM:
+    """GPT-2-medium scale: 24 layers, d=1024."""
+    kw = {"depth": 24, "dim": 1024, "num_heads": 16, "mlp_dim": 4096, **kw}
+    return TransformerLM(vocab=vocab, **kw)

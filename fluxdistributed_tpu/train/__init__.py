@@ -1,6 +1,6 @@
 from .logging import ConsoleLogger, Logger, current_logger, with_logger
 from .trainer import TrainTask, prepare_training, restore_training, train
-from .checkpoint import latest_step, load_checkpoint, save_checkpoint
+from .checkpoint import latest_step, load_checkpoint, save_checkpoint, wait_for_pending
 from .model_selection import (
     SelectionTask,
     prepare_model_selection,
@@ -17,6 +17,7 @@ __all__ = [
     "restore_training",
     "train",
     "save_checkpoint",
+    "wait_for_pending",
     "load_checkpoint",
     "latest_step",
     "SelectionTask",

@@ -30,23 +30,44 @@ from .. import tree as tree_lib
 
 Pytree = Any
 
-__all__ = ["save_checkpoint", "load_checkpoint", "latest_step"]
+__all__ = ["save_checkpoint", "load_checkpoint", "latest_step", "wait_for_pending"]
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+# Checkpointers with an async write still in flight (block=False saves).
+# At most one at a time: save_checkpoint drains it before starting the
+# next, and train()/callers drain at exit via wait_for_pending().
+_PENDING: list = []
 
 
 def _step_dir(directory: str, step: int) -> str:
     return os.path.join(os.path.abspath(directory), f"step_{step}")
 
 
-def save_checkpoint(state: Pytree, directory: str, step: int, overwrite: bool = True) -> str:
+def wait_for_pending() -> None:
+    """Block until any in-flight async save has committed to disk."""
+    while _PENDING:
+        _PENDING.pop().wait_until_finished()
+
+
+def save_checkpoint(
+    state: Pytree, directory: str, step: int, overwrite: bool = True,
+    block: bool = True,
+) -> str:
     """Write ``state`` (any pytree, e.g. ``TrainState``) at ``directory/step_<n>``.
+
+    ``block=False`` makes the disk write asynchronous: orbax's save copies
+    device arrays to host synchronously (so later donation/mutation of the
+    state cannot corrupt the snapshot) and streams to disk in a background
+    thread — the train loop keeps stepping during the write.  Call
+    :func:`wait_for_pending` (train() does) before relying on the file.
 
     Multi-host: the orbax save itself is collective (every host writes its
     addressable shards), but the pre-delete of an existing step dir runs
     on the coordinator only, behind a barrier — concurrent ``rmtree`` from
     N hosts on a shared filesystem would race the save.
     """
+    wait_for_pending()  # one in-flight save at a time
     path = _step_dir(directory, step)
     ckptr = ocp.StandardCheckpointer()
     if overwrite and os.path.exists(path):
@@ -59,7 +80,10 @@ def save_checkpoint(state: Pytree, directory: str, step: int, overwrite: bool = 
 
             multihost_utils.sync_global_devices("ckpt_rmtree")
     ckptr.save(path, state)
-    ckptr.wait_until_finished()
+    if block:
+        ckptr.wait_until_finished()
+    else:
+        _PENDING.append(ckptr)
     return path
 
 

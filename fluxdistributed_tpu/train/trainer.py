@@ -332,7 +332,9 @@ def train(
         if checkpoint_dir and checkpoint_every and j > 0 and j % checkpoint_every == 0:
             from .checkpoint import save_checkpoint
 
-            save_checkpoint(task.state, checkpoint_dir, int(task.state.step))
+            # async write: the device→host snapshot happens now, the disk
+            # write overlaps subsequent steps (drained before exit below)
+            save_checkpoint(task.state, checkpoint_dir, int(task.state.step), block=False)
 
     if profiling:
         tree_lib.synchronize(task.state.params)
@@ -340,6 +342,10 @@ def train(
         logger.info(f"profiler trace written to {profile_dir}")
     if task.num_missed:
         logger.info(f"missed {task.num_missed} batches due to OOM")
+    if checkpoint_dir:
+        from .checkpoint import wait_for_pending
+
+        wait_for_pending()
     host_params = tree_lib.to_host(task.state.params)
     host_mstate = tree_lib.to_host(task.state.model_state)
     return host_params, host_mstate, task

@@ -74,6 +74,41 @@ class _CaptureLogger:
         pass
 
 
+def test_async_checkpoint_commits(mesh, tmp_path):
+    """block=False saves must survive state mutation after the call (the
+    device→host snapshot is synchronous) and be fully on disk after
+    wait_for_pending — the train loop's contract."""
+    import jax
+
+    from fluxdistributed_tpu.train.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+        wait_for_pending,
+    )
+
+    task = _task(mesh)
+    snap = tree_lib.to_host(task.state.params)
+    save_checkpoint(task.state, str(tmp_path), 0, block=False)
+    # mutate state immediately: the async write must hold the snapshot
+    task.state = task.state.replace(
+        params=jax.tree.map(lambda x: x * 0.0, task.state.params)
+    )
+    wait_for_pending()
+    restored = load_checkpoint(str(tmp_path), step=0)
+    for a, b in zip(jax.tree.leaves(snap), jax.tree.leaves(restored["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_train_loop_async_checkpoint(mesh, tmp_path):
+    """train() uses async saves; files must be complete when train returns."""
+    from fluxdistributed_tpu.train import latest_step
+
+    task = _task(mesh, cycles=5)
+    train(task, print_every=0, eval_every=0, logger=NullLogger(),
+          checkpoint_dir=str(tmp_path), checkpoint_every=2)
+    assert latest_step(str(tmp_path)) is not None
+
+
 def test_throughput_metrics_logged(mesh):
     task = _task(mesh, cycles=6)
     logger = _CaptureLogger()

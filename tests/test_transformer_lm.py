@@ -1,0 +1,152 @@
+"""Decoder-only LM: causality, learning, and parallelism composition.
+
+The model exists to exercise the long-context machinery on a real
+sequence axis, so the tests cover exactly that: the causal invariant
+(future tokens cannot influence past logits), genuine learning on the
+Markov synthetic task (loss falls far below the uniform ln(V) floor),
+ring-attention sequence parallelism matching the dense-attention model,
+and FSDP compiling/stepping the same loss unchanged.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fluxdistributed_tpu import optim, sharding
+from fluxdistributed_tpu.data import SyntheticTextDataset
+from fluxdistributed_tpu.models import lm_loss_fn, lm_tiny
+from fluxdistributed_tpu.models.transformer_lm import next_token_loss, rope
+from fluxdistributed_tpu.parallel import (
+    TrainState,
+    fsdp,
+    fsdp_specs,
+    make_train_step,
+    make_train_step_fsdp,
+)
+
+VOCAB = 32
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = lm_tiny(vocab=VOCAB, dtype=jnp.float32)
+    toks = np.zeros((2, 16), np.int32)
+    params = model.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+    return model, params
+
+
+def test_causality(model_and_params):
+    """Perturbing token t must not change logits at positions < t."""
+    model, params = model_and_params
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, VOCAB, (1, 16)).astype(np.int32)
+    base = model.apply({"params": params}, toks, train=False)
+    t = 9
+    toks2 = toks.copy()
+    toks2[0, t] = (toks2[0, t] + 7) % VOCAB
+    pert = model.apply({"params": params}, toks2, train=False)
+    np.testing.assert_allclose(
+        np.asarray(base[0, :t]), np.asarray(pert[0, :t]), rtol=1e-5, atol=1e-5
+    )
+    # and it MUST change something at/after t (the model isn't ignoring input)
+    assert not np.allclose(np.asarray(base[0, t:]), np.asarray(pert[0, t:]))
+
+
+def test_rope_relative():
+    """RoPE scores depend only on relative distance: shifting all
+    positions by a constant leaves q·k scores unchanged."""
+    rng = jax.random.PRNGKey(1)
+    q = jax.random.normal(rng, (1, 8, 2, 16))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (1, 8, 2, 16))
+    pos = jnp.arange(8)
+    s0 = jnp.einsum(
+        "bqhd,bkhd->bhqk", rope(q, pos), rope(k, pos)
+    )
+    s1 = jnp.einsum(
+        "bqhd,bkhd->bhqk", rope(q, pos + 100), rope(k, pos + 100)
+    )
+    np.testing.assert_allclose(np.asarray(s0), np.asarray(s1), rtol=1e-4, atol=1e-4)
+
+
+def test_next_token_loss_mask():
+    logits = jnp.zeros((2, 5, VOCAB))
+    toks = jnp.zeros((2, 5), jnp.int32)
+    # uniform logits -> loss == ln(V) regardless of mask
+    full = next_token_loss(logits, toks)
+    np.testing.assert_allclose(float(full), np.log(VOCAB), rtol=1e-6)
+    mask = jnp.asarray([[True] * 5, [False] * 5])
+    np.testing.assert_allclose(
+        float(next_token_loss(logits, toks, mask)), np.log(VOCAB), rtol=1e-6
+    )
+
+
+def test_lm_learns_markov():
+    """DP training on the Markov chain: loss must fall well below the
+    uniform floor ln(V) — evidence of learning the transition table."""
+    import fluxdistributed_tpu.mesh as mesh_lib
+
+    mesh = mesh_lib.data_mesh(8)
+    model = lm_tiny(vocab=VOCAB, dtype=jnp.float32)
+    ds = SyntheticTextDataset(vocab=VOCAB, seqlen=32, peak=0.9)
+    rng = np.random.default_rng(0)
+    params = model.init(jax.random.PRNGKey(0), ds.batch(rng, 2), train=False)["params"]
+    opt = optim.adam(3e-3)
+    state = TrainState.create(sharding.replicate(params, mesh), opt)
+    step = make_train_step(lm_loss_fn(model), opt, mesh, donate=False)
+    first = last = None
+    for i in range(60):
+        b = sharding.shard_batch({"tokens": ds.batch(rng, 32)}, mesh)
+        state, m = step(state, b)
+        if i == 0:
+            first = float(m["loss"])
+        last = float(m["loss"])
+    assert first == pytest.approx(np.log(VOCAB), rel=0.15)
+    # peak=0.9 chain entropy ~= 0.69 nats; reaching <1.6 from 3.47 means
+    # the transition structure (not just unigram stats) was learned
+    assert last < 1.6, (first, last)
+
+
+def test_ring_attention_lm_matches_dense():
+    """The SAME weights under attn_fn=ring attention (seq-sharded mesh)
+    must reproduce the dense-attention model's logits."""
+    from fluxdistributed_tpu.mesh import make_mesh
+    from fluxdistributed_tpu.parallel import make_ring_attention
+
+    mesh = make_mesh({"seq": 8})
+    dense = lm_tiny(vocab=VOCAB, dtype=jnp.float32)
+    toks = np.random.default_rng(2).integers(0, VOCAB, (2, 32)).astype(np.int32)
+    params = dense.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+    ring = lm_tiny(
+        vocab=VOCAB, dtype=jnp.float32,
+        attn_fn=make_ring_attention(mesh, causal=True),
+    )
+    out_d = dense.apply({"params": params}, toks, train=False)
+    out_r = jax.jit(
+        lambda p, t: ring.apply({"params": p}, t, train=False)
+    )(params, toks)
+    np.testing.assert_allclose(
+        np.asarray(out_d), np.asarray(out_r), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_lm_fsdp_step():
+    """FSDP shards the LM state (embedding table is the biggest leaf)
+    and the compiled step runs the same lm loss unchanged."""
+    import fluxdistributed_tpu.mesh as mesh_lib
+
+    mesh = mesh_lib.data_mesh(8)
+    model = lm_tiny(vocab=64, dtype=jnp.float32)
+    toks = np.random.default_rng(3).integers(0, 64, (16, 32)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), toks[:2], train=False)["params"]
+    opt = optim.adam(1e-3)
+    state = TrainState.create(params, opt)
+    specs = fsdp_specs(state, mesh)
+    state = fsdp.shard_state(state, specs, mesh)
+    step = make_train_step_fsdp(lm_loss_fn(model), opt, mesh, specs, donate=False)
+    b = sharding.shard_batch({"tokens": toks}, mesh)
+    n = mesh.shape["data"]
+    emb = state.params["embed"]["embedding"]
+    assert emb.addressable_shards[0].data.size == emb.size // n
+    state, m = step(state, b)
+    assert np.isfinite(float(m["loss"]))
