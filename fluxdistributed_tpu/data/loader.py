@@ -32,11 +32,20 @@ __all__ = ["PrefetchLoader"]
 
 
 class PrefetchLoader:
-    """Iterate device-sharded ``{"image", "label"}`` batches with background prefetch.
+    """Iterate device-sharded batches with background prefetch.
+
+    The dataset's ``batch(rng, n)`` return decides the batch layout:
+
+    * ``(imgs, labels)`` tuple → ``{"image", "label"}`` (one-hot per
+      ``one_hot``) — the image-classification protocol;
+    * a dict of arrays → sharded as-is (each leaf's leading dim split);
+    * a single array → ``{"tokens": ...}`` — the LM protocol
+      (:class:`~fluxdistributed_tpu.data.SyntheticTextDataset`).
 
     Parameters
     ----------
-    dataset: object with ``nclasses`` and ``batch(rng, n) -> (imgs, labels)``
+    dataset: object with ``batch(rng, n)`` as above (``nclasses`` needed
+        only for the tuple protocol's one-hot labels)
     mesh: the device mesh; batches are sharded on ``axis``
     batch_size: *global* batch size (reference semantics: per-device batch
         × number of devices; README.md:43's 96/device × N)
@@ -46,7 +55,9 @@ class PrefetchLoader:
     buffersize: prefetch depth (reference default 5, src/ddp_tasks.jl:278)
     one_hot: emit one-hot labels (the reference's ``onehotbatch``,
         src/imagenet.jl:47); integer labels otherwise
-    transform: optional host-side ``(imgs, labels) -> (imgs, labels)``
+    transform: optional host-side hook, called per the dataset protocol:
+        ``transform(imgs, labels)`` for tuple datasets, ``transform(out)``
+        (one argument) for dict / bare-array datasets
     """
 
     def __init__(
@@ -97,23 +108,30 @@ class PrefetchLoader:
         # different rows (the analog of the reference's per-worker
         # sampling, src/sync.jl:135).
         rng = np.random.default_rng((self.seed, jax.process_index(), i))
-        imgs, labels = self.dataset.batch(rng, self._local_batch)
+        out = self.dataset.batch(rng, self._local_batch)
         if self.transform is not None:
-            imgs, labels = self.transform(imgs, labels)
-        return imgs, labels
+            out = self.transform(*out) if isinstance(out, tuple) else self.transform(out)
+        return out
 
-    def _put(self, imgs, labels):
+    def _put(self, out):
         from ..parallel.multihost import global_batch_put
 
-        y = np.asarray(labels)
-        batch = {
-            "image": global_batch_put(np.asarray(imgs), self.sharding),
-            "label": global_batch_put(
-                np.asarray(onehot(y, self.dataset.nclasses)) if self.one_hot else y,
-                self.sharding,
-            ),
-        }
-        return batch
+        if isinstance(out, tuple):
+            imgs, labels = out
+            y = np.asarray(labels)
+            return {
+                "image": global_batch_put(np.asarray(imgs), self.sharding),
+                "label": global_batch_put(
+                    np.asarray(onehot(y, self.dataset.nclasses)) if self.one_hot else y,
+                    self.sharding,
+                ),
+            }
+        if isinstance(out, dict):
+            return {
+                k: global_batch_put(np.asarray(v), self.sharding)
+                for k, v in out.items()
+            }
+        return {"tokens": global_batch_put(np.asarray(out), self.sharding)}
 
     # -- iteration ----------------------------------------------------
     def __len__(self) -> int:
@@ -141,11 +159,10 @@ class PrefetchLoader:
                     ahead.release()
                     break
                 try:
-                    imgs, labels = self._make_batch(i)
                     # device_put from a worker thread: transfer overlaps
                     # the consumer's compute, like the reference's
                     # prefetch tasks
-                    item = (i, self._put(imgs, labels), None)
+                    item = (i, self._put(self._make_batch(i)), None)
                 except Exception as e:  # surface to the consumer, don't die silently
                     item = (i, None, e)
                 while not stop.is_set():

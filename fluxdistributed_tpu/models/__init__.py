@@ -17,6 +17,7 @@ from .torch_import import (
 from .simple import SimpleCNN, MLP
 from .transformer_lm import (
     TransformerLM,
+    generate,
     lm_loss_fn,
     lm_medium,
     lm_small,
@@ -46,6 +47,7 @@ __all__ = [
     "SimpleCNN",
     "MLP",
     "TransformerLM",
+    "generate",
     "lm_loss_fn",
     "lm_tiny",
     "lm_small",

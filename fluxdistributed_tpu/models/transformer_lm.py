@@ -40,6 +40,7 @@ __all__ = [
     "lm_loss_fn",
     "next_token_loss",
     "rope",
+    "generate",
     "lm_tiny",
     "lm_small",
     "lm_medium",
@@ -72,12 +73,22 @@ def rope(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax.Array
 
 
 class CausalSelfAttention(nn.Module):
-    """QKV projection + RoPE + pluggable causal core + output projection."""
+    """QKV projection + RoPE + pluggable causal core + output projection.
+
+    ``decode=True`` switches to single-token autoregressive mode with a
+    KV cache: the cache buffers are created at ``init`` time (which
+    traces the full target length, fixing the static cache shape — no
+    dynamic shapes under jit), and each ``apply`` writes the new K/V at
+    ``cache_index`` via ``dynamic_update_slice`` and attends the one
+    query against the filled prefix.  O(T) per generated token instead
+    of O(T²) re-prefill.
+    """
 
     num_heads: int
     dtype: Any = jnp.bfloat16
     attn_fn: Optional[AttnFn] = None
     use_rope: bool = True
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -88,6 +99,44 @@ class CausalSelfAttention(nn.Module):
             (3, self.num_heads, head_dim), axis=-1, dtype=self.dtype, name="qkv"
         )(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+        if self.decode:
+            is_init = not self.has_variable("cache", "cached_k")
+            # at init, t is the FULL target length -> static cache shape
+            cached_k = self.variable(
+                "cache", "cached_k", jnp.zeros, (b, t, self.num_heads, head_dim), k.dtype
+            )
+            cached_v = self.variable(
+                "cache", "cached_v", jnp.zeros, (b, t, self.num_heads, head_dim), v.dtype
+            )
+            cache_index = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            if not is_init:
+                assert t == 1, "decode mode consumes one token per call"
+                idx = cache_index.value
+                total = cached_k.value.shape[1]
+                if self.use_rope:
+                    pos = idx[None]  # this token's global position
+                    q, k = rope(q, pos), rope(k, pos)
+                cached_k.value = jax.lax.dynamic_update_slice(
+                    cached_k.value, k, (0, idx, 0, 0)
+                )
+                cached_v.value = jax.lax.dynamic_update_slice(
+                    cached_v.value, v, (0, idx, 0, 0)
+                )
+                cache_index.value = idx + 1
+                # attend the single query over the filled prefix [0, idx]
+                allow = (jnp.arange(total) <= idx)[None, None, None, :]  # [1,1,1,T]
+                out = dot_product_attention(
+                    q, cached_k.value, cached_v.value, mask=allow
+                )
+                return nn.DenseGeneral(
+                    d, axis=(-2, -1), dtype=self.dtype, name="out"
+                )(out)
+            # fall through at init: trace the normal full-length path so
+            # every param/cache shape is fixed
+
         if self.use_rope:
             pos = jnp.arange(t)
             q, k = rope(q, pos), rope(k, pos)
@@ -107,13 +156,14 @@ class DecoderBlock(nn.Module):
     dropout: float = 0.0
     attn_fn: Optional[AttnFn] = None
     use_rope: bool = True
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool):
         y = nn.LayerNorm(dtype=self.dtype)(x)
         y = CausalSelfAttention(
             self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn,
-            use_rope=self.use_rope,
+            use_rope=self.use_rope, decode=self.decode,
         )(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -146,6 +196,7 @@ class TransformerLM(nn.Module):
     attn_fn: Optional[AttnFn] = None
     use_rope: bool = True
     tie_embeddings: bool = True
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
@@ -161,7 +212,7 @@ class TransformerLM(nn.Module):
             x = DecoderBlock(
                 self.num_heads, self.mlp_dim, dtype=self.dtype,
                 dropout=self.dropout, attn_fn=self.attn_fn,
-                use_rope=self.use_rope, name=f"block{i}",
+                use_rope=self.use_rope, decode=self.decode, name=f"block{i}",
             )(x, train=train)
         x = nn.LayerNorm(dtype=self.dtype, name="final_ln")(x)
         if self.tie_embeddings:
@@ -204,6 +255,72 @@ def lm_loss_fn(model: TransformerLM) -> Callable:
         )
 
     return fn
+
+
+def generate(
+    model: TransformerLM,
+    params,
+    prompt,
+    total_len: int,
+    temperature: float = 0.0,
+    rng=None,
+):
+    """Autoregressive sampling with the KV cache, as ONE compiled program.
+
+    ``model`` must be constructed with ``decode=True`` (and RoPE
+    positions — a learned positional table has no single-token lookup
+    path).  ``prompt`` [B, P] int32 is teacher-forced for its length,
+    then the model samples to ``total_len``: greedy at
+    ``temperature=0``, else softmax sampling with ``rng``.  The whole
+    loop is a ``lax.scan`` over single-token cache steps — static
+    shapes, one compilation, O(total_len) attention per token.
+
+    Returns tokens [B, total_len] (prompt included).
+    """
+    if not model.decode:
+        raise ValueError("generate() needs a model built with decode=True")
+    if not model.use_rope:
+        raise ValueError("generate() requires use_rope=True (a learned "
+                         "positional table has no per-token decode path)")
+    prompt = jnp.asarray(prompt, jnp.int32)
+    bsz, plen = prompt.shape
+    if not (0 < plen <= total_len):
+        raise ValueError(f"need 0 < prompt len ({plen}) <= total_len ({total_len})")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature > 0 samples stochastically — pass rng "
+                         "(a jax.random.PRNGKey) or use temperature=0 for greedy")
+    # cache shapes from an abstract init trace of the FULL length — no
+    # forward pass, no throwaway parameter materialization
+    spec = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros((bsz, total_len), jnp.int32), train=False
+        )
+    )["cache"]
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), spec)
+    padded = jnp.zeros((bsz, total_len), jnp.int32).at[:, :plen].set(prompt)
+    key = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def step(carry, t):
+        cache, tok, key = carry
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            train=False, mutable=["cache"],
+        )
+        key, sub = jax.random.split(key)
+        if temperature == 0.0:
+            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        else:
+            nxt = jax.random.categorical(
+                sub, logits[:, 0] / temperature, axis=-1
+            ).astype(jnp.int32)
+        # teacher-force while still inside the prompt
+        nxt = jnp.where(t + 1 < plen, padded[:, t + 1], nxt)
+        return (mut["cache"], nxt, key), nxt
+
+    (_, _, _), toks = jax.lax.scan(
+        step, (cache, prompt[:, 0], key), jnp.arange(total_len - 1)
+    )
+    return jnp.concatenate([prompt[:, :1], toks.T], axis=1)
 
 
 def lm_tiny(vocab: int = 256, **kw) -> TransformerLM:

@@ -74,6 +74,7 @@ def prepare_training(
     epochs: int = 1,
     cycles: Optional[int] = None,
     loss: Callable = logitcrossentropy,
+    loss_fn: Optional[Callable] = None,
     val_dataset=None,
     val_samples: int = 300,
     buffersize: int = 5,
@@ -103,21 +104,39 @@ def prepare_training(
     are gone and training cannot continue (the loop raises a clear error
     instead of continuing).  Default False, matching the reference's
     skip-and-continue semantics (src/ddp_tasks.jl:230-238).
+
+    ``loss_fn`` overrides the default image-classification adapter
+    (``flax_loss_fn(model, loss)``) with any function matching the
+    framework loss signature — e.g. ``models.lm_loss_fn(model)`` trains
+    the transformer LM on a token dataset through this same path (pass
+    ``topk=()``: top-k image metrics don't apply to LM batches).
     """
     mesh = mesh or mesh_lib.data_mesh()
-    if input_shape is None:
-        imgs, _ = dataset.batch(np.random.default_rng(0), 1)
-        input_shape = imgs.shape[1:]
+    if input_shape is not None:
+        dummy = np.zeros((1, *input_shape), np.float32)
+    else:
+        # draw one real sample so init sees the dataset's true shape AND
+        # dtype (f32 images, int32 tokens, ...)
+        sample = dataset.batch(np.random.default_rng(0), 1)
+        if isinstance(sample, tuple):
+            dummy = np.asarray(sample[0])
+        elif isinstance(sample, dict):
+            # model input by convention: 'tokens' (LM protocol), else the
+            # dict's first entry; pass input_shape explicitly otherwise
+            key = "tokens" if "tokens" in sample else next(iter(sample))
+            dummy = np.asarray(sample[key])
+        else:
+            dummy = np.asarray(sample)
 
     p_rng, d_rng = jax.random.split(jax.random.PRNGKey(seed))
-    dummy = np.zeros((1, *input_shape), np.float32)
     # 'dropout' stream present at init so stochastic models (ViT dropout,
     # ConvNeXt drop-path) initialize under train=True
     variables = model.init({"params": p_rng, "dropout": d_rng}, dummy, train=True)
     params = variables["params"]
     model_state = {k: v for k, v in variables.items() if k != "params"}  # e.g. batch_stats
 
-    loss_fn = flax_loss_fn(model, loss)
+    if loss_fn is None:
+        loss_fn = flax_loss_fn(model, loss)
     if spmd == "fsdp":
         from ..parallel import fsdp as fsdp_lib
 
@@ -170,13 +189,18 @@ def prepare_training(
         if was_augment:
             val_dataset.augment = False
         try:
-            vi, vl = val_dataset.batch(np.random.default_rng(seed + 1), nval)
+            vdraw = val_dataset.batch(np.random.default_rng(seed + 1), nval)
         finally:
             if was_augment:
                 val_dataset.augment = True
-        val_batch = sharding_lib.shard_batch(
-            {"image": vi, "label": np.asarray(onehot(vl, val_dataset.nclasses))}, mesh
-        )
+        if isinstance(vdraw, tuple):
+            vi, vl = vdraw
+            vdict = {"image": vi, "label": np.asarray(onehot(vl, val_dataset.nclasses))}
+        elif isinstance(vdraw, dict):
+            vdict = vdraw
+        else:
+            vdict = {"tokens": vdraw}
+        val_batch = sharding_lib.shard_batch(vdict, mesh)
 
     return TrainTask(
         state=state,
