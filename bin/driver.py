@@ -38,7 +38,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-classes", type=int, default=None,
                    help="override class count (default: dataset's)")
     p.add_argument("--dataset", default="synthetic",
-                   help="registered dataset name (Data.toml analog) or 'synthetic'")
+                   help="registered dataset name (Data.toml analog), 'synthetic' "
+                        "(images), or 'synthetic-text' (LM token stream)")
+    p.add_argument("--vocab", type=int, default=256,
+                   help="vocab size for lm_* models / synthetic-text")
+    p.add_argument("--seqlen", type=int, default=128,
+                   help="sequence length for synthetic-text")
     p.add_argument("--data-toml", default=None,
                    help="dataset registry TOML to load (Data.toml analog)")
     p.add_argument("--val-dataset", default=None, help="registered val dataset name")
@@ -104,12 +109,35 @@ def main(argv=None) -> int:
         dataset = SyntheticDataset(nsamples=max(args.batch_size * 8, 1024),
                                    nclasses=args.num_classes or 1000,
                                    shape=(args.image_size, args.image_size, 3))
+    elif args.dataset == "synthetic-text":
+        from fluxdistributed_tpu.data import SyntheticTextDataset
+
+        dataset = SyntheticTextDataset(vocab=args.vocab, seqlen=args.seqlen)
     else:
         dataset = fd.open_dataset(args.dataset)
     val_dataset = fd.open_dataset(args.val_dataset) if args.val_dataset else None
 
     model_fn = getattr(models, args.model)
-    model = model_fn(num_classes=args.num_classes or dataset.nclasses)
+    is_lm = args.model.startswith("lm_") or args.model == "TransformerLM"
+    if not is_lm and not hasattr(dataset, "nclasses"):
+        raise SystemExit(
+            f"--dataset {args.dataset} is a token stream; use an lm_* model"
+        )
+    if is_lm and hasattr(dataset, "nclasses"):
+        raise SystemExit(
+            f"--model {args.model} trains on tokens; use --dataset synthetic-text"
+        )
+    if is_lm:
+        # LM protocol: vocab-sized model, next-token loss, no top-k image
+        # metrics; cycles must be explicit (the text stream is unbounded)
+        model = model_fn(vocab=args.vocab)
+        lm_extra = {"loss_fn": models.lm_loss_fn(model), "topk": ()}
+        if args.cycles is None:
+            raise SystemExit("--cycles is required for lm_* models "
+                             "(synthetic-text has no epoch length)")
+    else:
+        model = model_fn(num_classes=args.num_classes or dataset.nclasses)
+        lm_extra = {}
 
     lr = args.lr
     if args.total_steps:
@@ -133,6 +161,7 @@ def main(argv=None) -> int:
         cycles=args.cycles,
         val_dataset=val_dataset,
         spmd=args.spmd,
+        **lm_extra,
     )
 
     if args.resume and args.checkpoint_dir:
@@ -156,6 +185,7 @@ def main(argv=None) -> int:
         task,
         print_every=args.print_every,
         eval_every=args.eval_every,
+        topk=() if is_lm else (1, 5, 10),
         logger=logger,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,

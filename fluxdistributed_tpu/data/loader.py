@@ -28,7 +28,39 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import mesh as mesh_lib
 from ..ops import onehot
 
-__all__ = ["PrefetchLoader"]
+__all__ = ["PrefetchLoader", "batch_to_dict", "model_input"]
+
+
+def batch_to_dict(out, nclasses=None, one_hot: bool = True) -> dict:
+    """Normalize a ``dataset.batch()`` return to the framework batch dict.
+
+    THE single implementation of the three dataset protocols (tuple /
+    dict / bare array) — the loader, the trainer's val draw, and init
+    shape inference all go through here so the protocols cannot drift.
+    """
+    if isinstance(out, tuple):
+        imgs, labels = out
+        y = np.asarray(labels)
+        if one_hot:
+            if nclasses is None:
+                raise ValueError(
+                    "one_hot labels need nclasses (dataset lacks .nclasses)"
+                )
+            y = np.asarray(onehot(y, nclasses))
+        return {"image": np.asarray(imgs), "label": y}
+    if isinstance(out, dict):
+        return {k: np.asarray(v) for k, v in out.items()}
+    return {"tokens": np.asarray(out)}
+
+
+def model_input(out) -> np.ndarray:
+    """The array a model's ``init`` should trace from a ``batch()`` draw:
+    ``image`` / ``tokens`` by convention, else the dict's first entry."""
+    d = batch_to_dict(out, one_hot=False)
+    for k in ("image", "tokens"):
+        if k in d:
+            return d[k]
+    return next(iter(d.values()))
 
 
 class PrefetchLoader:
@@ -96,6 +128,12 @@ class PrefetchLoader:
 
         self._local_batch = multihost.local_batch_size(batch_size)
         if cycles is None:
+            if not hasattr(dataset, "__len__"):
+                raise ValueError(
+                    f"{type(dataset).__name__} has no __len__ (an unbounded "
+                    "stream, e.g. a generated token dataset) — pass cycles= "
+                    "explicitly instead of deriving it from epochs"
+                )
             cycles = max(1, (len(dataset) * epochs) // batch_size)
         self.cycles = cycles
 
@@ -116,22 +154,10 @@ class PrefetchLoader:
     def _put(self, out):
         from ..parallel.multihost import global_batch_put
 
-        if isinstance(out, tuple):
-            imgs, labels = out
-            y = np.asarray(labels)
-            return {
-                "image": global_batch_put(np.asarray(imgs), self.sharding),
-                "label": global_batch_put(
-                    np.asarray(onehot(y, self.dataset.nclasses)) if self.one_hot else y,
-                    self.sharding,
-                ),
-            }
-        if isinstance(out, dict):
-            return {
-                k: global_batch_put(np.asarray(v), self.sharding)
-                for k, v in out.items()
-            }
-        return {"tokens": global_batch_put(np.asarray(out), self.sharding)}
+        d = batch_to_dict(
+            out, getattr(self.dataset, "nclasses", None), self.one_hot
+        )
+        return {k: global_batch_put(v, self.sharding) for k, v in d.items()}
 
     # -- iteration ----------------------------------------------------
     def __len__(self) -> int:

@@ -113,11 +113,13 @@ class CausalSelfAttention(nn.Module):
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
             )
             if not is_init:
-                assert t == 1, "decode mode consumes one token per call"
+                # t == 1: one sampling step.  t > 1: batched PREFILL — the
+                # whole prompt's K/V written in one parallel pass (one
+                # matmul-dense forward) instead of t sequential steps.
                 idx = cache_index.value
                 total = cached_k.value.shape[1]
                 if self.use_rope:
-                    pos = idx[None]  # this token's global position
+                    pos = idx + jnp.arange(t)  # global positions
                     q, k = rope(q, pos), rope(k, pos)
                 cached_k.value = jax.lax.dynamic_update_slice(
                     cached_k.value, k, (0, idx, 0, 0)
@@ -125,9 +127,11 @@ class CausalSelfAttention(nn.Module):
                 cached_v.value = jax.lax.dynamic_update_slice(
                     cached_v.value, v, (0, idx, 0, 0)
                 )
-                cache_index.value = idx + 1
-                # attend the single query over the filled prefix [0, idx]
-                allow = (jnp.arange(total) <= idx)[None, None, None, :]  # [1,1,1,T]
+                cache_index.value = idx + t
+                # query i (global position idx+i) attends keys [0, idx+i]
+                allow = (
+                    jnp.arange(total)[None, :] <= (idx + jnp.arange(t))[:, None]
+                )[None, None]  # [1, 1, t, total]
                 out = dot_product_attention(
                     q, cached_k.value, cached_v.value, mask=allow
                 )
@@ -269,11 +273,12 @@ def generate(
 
     ``model`` must be constructed with ``decode=True`` (and RoPE
     positions — a learned positional table has no single-token lookup
-    path).  ``prompt`` [B, P] int32 is teacher-forced for its length,
-    then the model samples to ``total_len``: greedy at
-    ``temperature=0``, else softmax sampling with ``rng``.  The whole
-    loop is a ``lax.scan`` over single-token cache steps — static
-    shapes, one compilation, O(total_len) attention per token.
+    path).  The prompt [B, P] int32 is PREFILLED in one parallel
+    full-width forward (writing all P keys/values into the cache at
+    once), then a ``lax.scan`` of single-token cache steps samples out
+    to ``total_len``: greedy at ``temperature=0``, else softmax
+    sampling with ``rng``.  Static shapes throughout — one compile per
+    (B, P, total_len).
 
     Returns tokens [B, total_len] (prompt included).
     """
@@ -297,30 +302,40 @@ def generate(
         )
     )["cache"]
     cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), spec)
-    padded = jnp.zeros((bsz, total_len), jnp.int32).at[:, :plen].set(prompt)
     key = rng if rng is not None else jax.random.PRNGKey(0)
 
-    def step(carry, t):
+    def sample(logits, sub):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            sub, logits / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    # prefill: one parallel pass over the whole prompt
+    logits_p, mut = model.apply(
+        {"params": params, "cache": cache}, prompt, train=False, mutable=["cache"]
+    )
+    cache = mut["cache"]
+    key, sub = jax.random.split(key)
+    first = sample(logits_p[:, -1], sub)
+    if total_len == plen:
+        return prompt
+
+    def step(carry, _):
         cache, tok, key = carry
         logits, mut = model.apply(
             {"params": params, "cache": cache}, tok[:, None],
             train=False, mutable=["cache"],
         )
         key, sub = jax.random.split(key)
-        if temperature == 0.0:
-            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-        else:
-            nxt = jax.random.categorical(
-                sub, logits[:, 0] / temperature, axis=-1
-            ).astype(jnp.int32)
-        # teacher-force while still inside the prompt
-        nxt = jnp.where(t + 1 < plen, padded[:, t + 1], nxt)
+        nxt = sample(logits[:, 0], sub)
         return (mut["cache"], nxt, key), nxt
 
     (_, _, _), toks = jax.lax.scan(
-        step, (cache, prompt[:, 0], key), jnp.arange(total_len - 1)
+        step, (cache, first, key), None, length=total_len - plen - 1
     )
-    return jnp.concatenate([prompt[:, :1], toks.T], axis=1)
+    out = jnp.concatenate([prompt, first[:, None], toks.T], axis=1)
+    return out
 
 
 def lm_tiny(vocab: int = 256, **kw) -> TransformerLM:

@@ -117,16 +117,9 @@ def prepare_training(
     else:
         # draw one real sample so init sees the dataset's true shape AND
         # dtype (f32 images, int32 tokens, ...)
-        sample = dataset.batch(np.random.default_rng(0), 1)
-        if isinstance(sample, tuple):
-            dummy = np.asarray(sample[0])
-        elif isinstance(sample, dict):
-            # model input by convention: 'tokens' (LM protocol), else the
-            # dict's first entry; pass input_shape explicitly otherwise
-            key = "tokens" if "tokens" in sample else next(iter(sample))
-            dummy = np.asarray(sample[key])
-        else:
-            dummy = np.asarray(sample)
+        from ..data.loader import model_input
+
+        dummy = model_input(dataset.batch(np.random.default_rng(0), 1))
 
     p_rng, d_rng = jax.random.split(jax.random.PRNGKey(seed))
     # 'dropout' stream present at init so stochastic models (ViT dropout,
@@ -193,14 +186,11 @@ def prepare_training(
         finally:
             if was_augment:
                 val_dataset.augment = True
-        if isinstance(vdraw, tuple):
-            vi, vl = vdraw
-            vdict = {"image": vi, "label": np.asarray(onehot(vl, val_dataset.nclasses))}
-        elif isinstance(vdraw, dict):
-            vdict = vdraw
-        else:
-            vdict = {"tokens": vdraw}
-        val_batch = sharding_lib.shard_batch(vdict, mesh)
+        from ..data.loader import batch_to_dict
+
+        val_batch = sharding_lib.shard_batch(
+            batch_to_dict(vdraw, getattr(val_dataset, "nclasses", None)), mesh
+        )
 
     return TrainTask(
         state=state,

@@ -152,6 +152,24 @@ def test_decode_cache_matches_full_forward(model_and_params):
     got = np.stack(got, axis=1)
     np.testing.assert_allclose(np.asarray(full), got, rtol=2e-4, atol=2e-4)
 
+    # batched prefill (first 7 tokens in ONE pass) + single-token steps
+    cache = dm.init(jax.random.PRNGKey(0), jnp.zeros_like(toks), train=False)["cache"]
+    pre, mut = dm.apply(
+        {"params": params, "cache": cache}, toks[:, :7],
+        train=False, mutable=["cache"],
+    )
+    cache = mut["cache"]
+    got2 = [np.asarray(pre)]
+    for t in range(7, toks.shape[1]):
+        logits, mut = dm.apply(
+            {"params": params, "cache": cache}, toks[:, t : t + 1],
+            train=False, mutable=["cache"],
+        )
+        cache = mut["cache"]
+        got2.append(np.asarray(logits))
+    got2 = np.concatenate(got2, axis=1)
+    np.testing.assert_allclose(np.asarray(full), got2, rtol=2e-4, atol=2e-4)
+
 
 def test_generate_follows_markov_chain():
     """Train on the chain, then generate greedily: every sampled
