@@ -16,7 +16,7 @@ from .ep import (
     stack_expert_params,
 )
 from .pp import make_train_step_pp, pipeline_apply, stack_stage_params, switch_stage
-from .tp import make_train_step_tp, param_specs, shard_state, vit_tp_rules
+from .tp import lm_tp_rules, make_train_step_tp, param_specs, shard_state, vit_tp_rules
 
 __all__ = [
     "multihost",
@@ -41,6 +41,7 @@ __all__ = [
     "param_specs",
     "shard_state",
     "vit_tp_rules",
+    "lm_tp_rules",
     "pipeline_apply",
     "make_train_step_pp",
     "stack_stage_params",

@@ -34,6 +34,7 @@ __all__ = [
     "state_specs",
     "shard_state",
     "vit_tp_rules",
+    "lm_tp_rules",
     "make_train_step_tp",
 ]
 
@@ -119,6 +120,45 @@ def vit_tp_rules(model_axis: str = "model") -> Callable[[str, Any], P]:
         if "MlpBlock" in path and path.endswith("Dense_0/bias"):
             return P(model_axis)
         if "MlpBlock" in path and path.endswith("Dense_1/kernel"):
+            return P(model_axis, None)
+        return P()
+
+    return rule
+
+
+def lm_tp_rules(
+    model_axis: str = "model", shard_vocab: bool = True
+) -> Callable[[str, Any], P]:
+    """Megatron-style rules for ``models.transformer_lm.TransformerLM``.
+
+    Same block pattern as :func:`vit_tp_rules` (qkv column-sharded over
+    heads, attention out row-sharded, MLP up column-/down row-sharded;
+    DecoderBlock's MLP is plain ``Dense_0``/``Dense_1``), plus the LM
+    embedding: ``embed/embedding [vocab, dim]`` vocab-sharded (Megatron's
+    parallel vocab embedding — with tied embeddings the output
+    projection's logits come out vocab-sharded and GSPMD all-gathers at
+    the f32 log-softmax).  Requires heads, mlp_dim and (if
+    ``shard_vocab``) vocab divisible by the model-axis size.
+    """
+
+    def rule(path: str, leaf) -> P:
+        if path.endswith("embed/embedding"):
+            return P(model_axis, None) if shard_vocab else P()
+        if path.endswith("qkv/kernel"):
+            return P(None, None, model_axis, None)
+        if path.endswith("qkv/bias"):
+            return P(None, model_axis, None)
+        if path.endswith("out/kernel"):
+            return P(model_axis, None, None)
+        if path.endswith("head/kernel"):  # untied output head
+            return P(None, model_axis)
+        if path.endswith("head/bias"):  # column-parallel bias follows output dim
+            return P(model_axis)
+        if path.endswith("Dense_0/kernel"):
+            return P(None, model_axis)
+        if path.endswith("Dense_0/bias"):
+            return P(model_axis)
+        if path.endswith("Dense_1/kernel"):
             return P(model_axis, None)
         return P()
 
