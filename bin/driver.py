@@ -113,6 +113,12 @@ def main(argv=None) -> int:
         from fluxdistributed_tpu.data import SyntheticTextDataset
 
         dataset = SyntheticTextDataset(vocab=args.vocab, seqlen=args.seqlen)
+    elif args.dataset.startswith("text:"):
+        # byte-level LM on any local file: --dataset text:/path/corpus.txt
+        from fluxdistributed_tpu.data import ByteTextDataset
+
+        dataset = ByteTextDataset(args.dataset[len("text:"):], seqlen=args.seqlen)
+        args.vocab = dataset.vocab
     else:
         dataset = fd.open_dataset(args.dataset)
     val_dataset = fd.open_dataset(args.val_dataset) if args.val_dataset else None
@@ -132,9 +138,10 @@ def main(argv=None) -> int:
         # metrics; cycles must be explicit (the text stream is unbounded)
         model = model_fn(vocab=args.vocab)
         lm_extra = {"loss_fn": models.lm_loss_fn(model), "topk": ()}
-        if args.cycles is None:
-            raise SystemExit("--cycles is required for lm_* models "
-                             "(synthetic-text has no epoch length)")
+        if args.cycles is None and not hasattr(dataset, "__len__"):
+            raise SystemExit("--cycles is required for unbounded token "
+                             "streams (synthetic-text has no epoch length; "
+                             "text: datasets derive cycles from --epochs)")
     else:
         model = model_fn(num_classes=args.num_classes or dataset.nclasses)
         lm_extra = {}

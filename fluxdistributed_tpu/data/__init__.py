@@ -5,6 +5,7 @@ from .preprocess import preprocess
 from .registry import load_registry, open_dataset, register_dataset
 from .sources import FileSource, GCSSource, HTTPSource, make_source
 from .synthetic import SyntheticDataset, SyntheticTextDataset
+from .text import ByteTextDataset
 
 __all__ = [
     "CIFAR10Dataset",
@@ -24,6 +25,7 @@ __all__ = [
     "make_source",
     "SyntheticDataset",
     "SyntheticTextDataset",
+    "ByteTextDataset",
     "minibatch",
 ]
 

@@ -80,10 +80,17 @@ def _driver_synthetic(spec: dict):
     )
 
 
+def _driver_text(spec: dict):
+    from .text import ByteTextDataset
+
+    return ByteTextDataset(spec["path"], seqlen=int(spec.get("seqlen", 256)))
+
+
 DRIVERS: dict[str, Callable[[dict], Any]] = {
     "imagenet": _driver_imagenet,
     "cifar10": _driver_cifar10,
     "synthetic": _driver_synthetic,
+    "text": _driver_text,
 }
 
 

@@ -1,0 +1,56 @@
+"""Byte-level text dataset: train the LM on any local file.
+
+The token-protocol analog of the image datasets (the reference's data
+layer is vision-only, src/imagenet.jl — this extends the same registry/
+loader machinery to the LM family): a UTF-8/binary file is memory-mapped
+and batches are random fixed-length byte windows, vocab = 256.  No
+tokenizer dependency — byte-level modeling needs none — and windows are
+drawn with replacement, matching the framework's sampling semantics
+(``key[rand(1:nrow, n), :]`` src/imagenet.jl:24).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["ByteTextDataset"]
+
+
+class ByteTextDataset:
+    """Random ``seqlen``-byte windows over a memory-mapped file.
+
+    Protocol: ``batch(rng, n) -> tokens [n, seqlen] int32`` (the
+    PrefetchLoader's bare-array/token protocol); ``len(ds)`` is the
+    number of non-overlapping windows, so ``epochs``-based cycle
+    derivation works.
+    """
+
+    vocab = 256
+
+    def __init__(self, path: str, seqlen: int = 256):
+        self.path = os.fspath(path)
+        self.seqlen = int(seqlen)
+        size = os.path.getsize(self.path)
+        if size < self.seqlen + 1:
+            raise ValueError(
+                f"{self.path}: {size} bytes < seqlen+1 ({self.seqlen + 1}) — "
+                "need at least one full window plus a next-token target"
+            )
+        # mmap: no copy of the corpus per worker thread, OS page cache
+        # shared across processes on a host
+        self._data = np.memmap(self.path, dtype=np.uint8, mode="r")
+
+    def __len__(self) -> int:
+        return max(1, (len(self._data) - 1) // self.seqlen)
+
+    def batch(self, rng: np.random.Generator, n: int) -> np.ndarray:
+        starts = rng.integers(0, len(self._data) - self.seqlen, size=n)
+        idx = starts[:, None] + np.arange(self.seqlen)[None, :]
+        return self._data[idx].astype(np.int32)
+
+    @staticmethod
+    def decode(tokens) -> str:
+        """Bytes → text (lossy on invalid UTF-8), for eyeballing samples."""
+        return bytes(np.asarray(tokens, np.uint8)).decode("utf-8", errors="replace")

@@ -194,3 +194,35 @@ def test_registry_split_and_augment_keys(imagenet_root):
     )
     dv = open_dataset("inet_val")
     assert dv.table.split == "val" and dv.augment is False
+
+
+def test_byte_text_dataset(tmp_path):
+    """Windows are exact byte slices; len counts non-overlapping windows;
+    the registry's text driver opens it; decode round-trips."""
+    from fluxdistributed_tpu.data import ByteTextDataset
+    from fluxdistributed_tpu.data.registry import register_dataset
+
+    corpus = (b"the quick brown fox jumps over the lazy dog. " * 50)
+    p = tmp_path / "corpus.txt"
+    p.write_bytes(corpus)
+
+    ds = ByteTextDataset(str(p), seqlen=16)
+    assert ds.vocab == 256
+    assert len(ds) == (len(corpus) - 1) // 16
+    rng = np.random.default_rng(0)
+    toks = ds.batch(rng, 8)
+    assert toks.shape == (8, 16) and toks.dtype == np.int32
+    # every window is a literal slice of the file
+    blob = corpus
+    for row in toks:
+        assert bytes(row.astype(np.uint8)) in blob
+    assert ByteTextDataset.decode(np.frombuffer(b"fox", np.uint8)) == "fox"
+
+    register_dataset("corpus", "text", path=str(p), seqlen=16)
+    ds2 = open_dataset("corpus")
+    assert ds2.seqlen == 16 and len(ds2) == len(ds)
+
+    with pytest.raises(ValueError, match="seqlen"):
+        small = tmp_path / "small.txt"
+        small.write_bytes(b"xy")
+        ByteTextDataset(str(small), seqlen=16)
