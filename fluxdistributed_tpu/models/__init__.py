@@ -20,6 +20,7 @@ from .transformer_lm import (
     generate,
     lm_loss_fn,
     lm_medium,
+    lm_pp,
     lm_small,
     lm_tiny,
     next_token_loss,
@@ -49,6 +50,7 @@ __all__ = [
     "TransformerLM",
     "generate",
     "lm_loss_fn",
+    "lm_pp",
     "lm_tiny",
     "lm_small",
     "lm_medium",

@@ -41,6 +41,7 @@ __all__ = [
     "next_token_loss",
     "rope",
     "generate",
+    "lm_pp",
     "lm_tiny",
     "lm_small",
     "lm_medium",
@@ -336,6 +337,103 @@ def generate(
     )
     out = jnp.concatenate([prompt, first[:, None], toks.T], axis=1)
     return out
+
+
+def lm_pp(
+    model: TransformerLM,
+    mesh,
+    pipe_axis: str = "pipe",
+    batch_axis: Optional[str] = None,
+    num_microbatches: Optional[int] = None,
+):
+    """Pipeline-parallelize the LM: blocks ride the GPipe schedule.
+
+    The decoder stack is the textbook pipeline body — every
+    ``DecoderBlock`` preserves the residual-stream shape, so block *i*
+    becomes pipe stage *i* (``parallel.pp.pipeline_apply``); the
+    embedding lookup, final LayerNorm, and (tied) logits projection
+    compose outside the pipelined middle, replicated.
+
+    Returns ``(split_params, loss_fn, state_shardings)``:
+
+    * ``split_params(params)`` maps a full-model param tree to
+      ``{"outer": ..., "stages": ...}`` with the S block trees stacked
+      on a leading dim sharded over ``pipe_axis``;
+    * ``loss_fn`` follows the framework loss signature on the split
+      tree (so ``dp.make_train_step`` compiles it unchanged);
+    * ``state_shardings(state)`` builds the ``TrainState`` sharding tree
+      (outer replicated, stages pipe-sharded, optimizer state
+      following its param) to pass as ``state_shardings=``.
+
+    ``batch_axis`` composes data parallelism on a ``(data, pipe)`` mesh.
+    Constraints: ``use_rope`` (positions live inside the blocks) and
+    ``dropout == 0`` (no rng stream threads through the pipeline ticks).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.pp import pipeline_apply, stack_stage_params
+
+    if not model.use_rope:
+        raise ValueError("lm_pp needs use_rope=True (a positional table "
+                         "would have to enter mid-pipeline)")
+    if model.dropout:
+        raise ValueError("lm_pp supports dropout=0 only (no rng stream "
+                         "threads through the pipeline schedule)")
+    if mesh.shape[pipe_axis] != model.depth:
+        raise ValueError(
+            f"model.depth ({model.depth}) must equal the '{pipe_axis}' axis "
+            f"size ({mesh.shape[pipe_axis]}); use chunk_stages for V>1 "
+            "blocks per device"
+        )
+
+    blk = DecoderBlock(
+        model.num_heads, model.mlp_dim, dtype=model.dtype,
+        dropout=0.0, use_rope=model.use_rope, attn_fn=model.attn_fn,
+    )
+    fwd = pipeline_apply(
+        lambda p, x: blk.apply({"params": p}, x, train=False),
+        mesh, axis=pipe_axis, num_microbatches=num_microbatches,
+        batch_axis=batch_axis,
+    )
+    embed = nn.Embed(model.vocab, model.dim, dtype=model.dtype)
+    ln = nn.LayerNorm(dtype=model.dtype)
+
+    def split_params(params):
+        stages = [params[f"block{i}"] for i in range(model.depth)]
+        outer = {k: v for k, v in params.items() if not k.startswith("block")}
+        return {
+            "outer": outer,
+            "stages": stack_stage_params(stages, mesh, pipe_axis),
+        }
+
+    def loss_fn(params, model_state, batch, train: bool, rng=None):
+        tokens = batch["tokens"]
+        outer = params["outer"]
+        x = embed.apply({"params": outer["embed"]}, tokens)
+        x = fwd(params["stages"], x)
+        x = ln.apply({"params": outer["final_ln"]}, x)
+        if model.tie_embeddings:
+            logits = embed.apply({"params": outer["embed"]}, x, method="attend")
+        else:
+            logits = nn.Dense(model.vocab, dtype=model.dtype).apply(
+                {"params": outer["head"]}, x
+            )
+        logits = jnp.asarray(logits, jnp.float32)
+        return next_token_loss(logits, tokens, batch.get("mask")), (
+            model_state, logits,
+        )
+
+    def state_shardings(state):
+        from ..parallel.tp import state_specs
+        from ..sharding import make_shardings
+
+        p_specs = {
+            "outer": jax.tree.map(lambda _: P(), state.params["outer"]),
+            "stages": jax.tree.map(lambda _: P(pipe_axis), state.params["stages"]),
+        }
+        return make_shardings(state_specs(state, p_specs), mesh)
+
+    return split_params, loss_fn, state_shardings
 
 
 def lm_tiny(vocab: int = 256, **kw) -> TransformerLM:

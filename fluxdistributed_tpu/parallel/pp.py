@@ -136,13 +136,19 @@ def pipeline_apply(
     mesh: Mesh,
     axis: str = PIPE_AXIS,
     num_microbatches: Optional[int] = None,
+    batch_axis: Optional[str] = None,
 ):
     """Build ``fwd(stacked_params, x) -> y`` running the GPipe schedule.
 
     ``stacked_params`` leaves have leading dim S sharded on ``axis``;
-    ``x`` is the global batch (replicated input spec — only stage 0 reads
-    it; the compiler keeps the unused copies unrealized).  Output is the
-    last stage's activations for the full batch, replicated.
+    ``x`` is the batch (replicated input spec — only stage 0 reads it;
+    the compiler keeps the unused copies unrealized).  Output is the
+    last stage's activations, same batch layout as the input.
+
+    ``batch_axis`` composes data parallelism with the pipeline on a 2-D
+    ``(data, pipe)`` mesh: ``x``'s leading dim is sharded over
+    ``batch_axis`` and each data-parallel row of the mesh pipelines its
+    own shard (microbatch count M divides the per-shard batch).
     """
     S = mesh.shape[axis]
     M = num_microbatches or S
@@ -158,8 +164,8 @@ def pipeline_apply(
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(),
+        in_specs=(P(axis), P(batch_axis)),
+        out_specs=P(batch_axis),
     )
     def run(stacked_params, x):
         params = jax.tree.map(lambda p: p[0], stacked_params)  # my stage's slice
