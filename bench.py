@@ -65,6 +65,7 @@ def build_step(
     accum_steps: int = 1,
     norm_dtype=None,
     input_f32: bool = False,
+    remat: bool = False,
 ):
     """Build the headline measurement target: ResNet-50, DP mesh over all
     chips, compiled train step, device-resident batch.
@@ -84,7 +85,7 @@ def build_step(
     from fluxdistributed_tpu.parallel.dp import flax_loss_fn
 
     mesh = fd.data_mesh()
-    model = resnet50(num_classes=1000, norm_dtype=norm_dtype)
+    model = resnet50(num_classes=1000, norm_dtype=norm_dtype, remat=remat)
     rng = np.random.default_rng(0)
     x = rng.normal(0, 1, (batch, size, size, 3)).astype(np.float32)
     y = rng.integers(0, 1000, batch)

@@ -31,6 +31,10 @@ CONFIGS = [
     {"name": "no-donate", "env": {"SWEEP_NO_DONATE": "1"}},
     {"name": "batch-512", "env": {"SWEEP_BATCH": "512"}},
     {"name": "grad-accum-2", "env": {"SWEEP_ACCUM": "2", "SWEEP_BATCH": "512"}},
+    # remat trades ~1 extra forward for O(depth)x less activation memory;
+    # worth it iff the bigger batch it unlocks beats the FLOPs cost
+    {"name": "remat-512", "env": {"SWEEP_REMAT": "1", "SWEEP_BATCH": "512"}},
+    {"name": "remat-1024", "env": {"SWEEP_REMAT": "1", "SWEEP_BATCH": "1024"}},
 ]
 
 
@@ -53,6 +57,7 @@ def measure_one() -> dict:
         accum_steps=int(os.environ.get("SWEEP_ACCUM", "1")),
         norm_dtype=jnp.float32 if _env_flag("SWEEP_BN_F32") else None,
         input_f32=_env_flag("SWEEP_INPUT_F32"),
+        remat=_env_flag("SWEEP_REMAT"),
     )
     dt, _ = bench.time_compiled_step(
         step, state, b, target_seconds=float(os.environ.get("SWEEP_SECONDS", "2.0"))

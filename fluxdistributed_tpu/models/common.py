@@ -1,0 +1,31 @@
+"""Shared model-building helpers."""
+
+from __future__ import annotations
+
+from flax import linen as nn
+
+__all__ = ["maybe_remat"]
+
+
+def maybe_remat(block_cls, enabled: bool, train_argnum: int | None = None):
+    """Wrap a block Module class in ``nn.remat`` when ``enabled``.
+
+    ``train_argnum`` marks the block's ``train`` flag static so flax's
+    remat does not trace it into a ``bool[]`` tracer (which would break
+    ``deterministic=not train``).  Argnums count ``self``: for
+    ``__call__(self, x, train)`` pass 2 — and the call site must pass
+    ``train`` POSITIONALLY (flax remat traces kwargs regardless of
+    static_argnums).  Blocks whose ``__call__`` takes no train flag
+    (ResNet blocks — BatchNorm mode is baked in via the ``norm``
+    partial) pass ``None``.
+
+    Remat callers must also pin each block's ``name=`` to the unwrapped
+    auto-name: the wrapper class is named ``Checkpoint<Block>`` and
+    would otherwise rename flax scopes, orphaning checkpoints and
+    imported torch weights (asserted by ``tests/test_remat.py``).
+    """
+    if not enabled:
+        return block_cls
+    if train_argnum is None:
+        return nn.remat(block_cls)
+    return nn.remat(block_cls, static_argnums=(train_argnum,))

@@ -47,7 +47,7 @@ class ConvNeXtBlock(nn.Module):
     gelu_exact: bool = False  # erf GELU (torch default) vs tanh approx (TPU-fast)
 
     @nn.compact
-    def __call__(self, x, *, train: bool):
+    def __call__(self, x, train: bool = True):
         shortcut = x
         x = nn.Conv(
             self.dim, (7, 7), padding="SAME",
@@ -96,6 +96,9 @@ class ConvNeXt(nn.Module):
     layer_scale_init: float = 1e-6
     dtype: Any = jnp.bfloat16
     gelu_exact: bool = False  # torchvision/official-checkpoint compat
+    # rematerialize each block in the backward pass (activation memory
+    # O(1 block) for ~1 extra forward of FLOPs)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -105,6 +108,9 @@ class ConvNeXt(nn.Module):
         )(x)
         x = nn.LayerNorm(dtype=self.dtype, name="stem_norm")(x)
         total = sum(self.depths)
+        from .common import maybe_remat
+
+        block_cls = maybe_remat(ConvNeXtBlock, self.remat, train_argnum=2)
         block = 0
         for stage, (depth, dim) in enumerate(zip(self.depths, self.dims)):
             if stage > 0:
@@ -112,11 +118,11 @@ class ConvNeXt(nn.Module):
             for _ in range(depth):
                 # linearly increasing drop-path rate, as in the paper
                 dp = self.drop_path_rate * block / max(total - 1, 1)
-                x = ConvNeXtBlock(
+                x = block_cls(
                     dim, drop_path=dp, layer_scale_init=self.layer_scale_init,
                     dtype=self.dtype, gelu_exact=self.gelu_exact,
                     name=f"block{block}",
-                )(x, train=train)
+                )(x, train)
                 block += 1
         x = x.mean(axis=(1, 2))
         x = nn.LayerNorm(dtype=jnp.float32, name="head_norm")(x)

@@ -164,7 +164,9 @@ class DecoderBlock(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, *, train: bool):
+    def __call__(self, x, train: bool = True):
+        # train is positional-or-keyword (unlike the package's other
+        # blocks) so nn.remat can mark it static via static_argnums
         y = nn.LayerNorm(dtype=self.dtype)(x)
         y = CausalSelfAttention(
             self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn,
@@ -202,6 +204,11 @@ class TransformerLM(nn.Module):
     use_rope: bool = True
     tie_embeddings: bool = True
     decode: bool = False
+    # rematerialize each block in the backward pass: activations for only
+    # ~one block live at a time, trading ~1 extra forward of FLOPs for
+    # O(depth)x less activation memory -> longer sequences / bigger
+    # batches per chip (jax.checkpoint, the TPU HBM lever)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
@@ -213,12 +220,17 @@ class TransformerLM(nn.Module):
                 "pos_embedding", nn.initializers.normal(0.02), (t, self.dim)
             )
             x = x + jnp.asarray(pos_tab, self.dtype)[None]
+        from .common import maybe_remat
+
+        block_cls = maybe_remat(
+            DecoderBlock, self.remat and not self.decode, train_argnum=2
+        )
         for i in range(self.depth):
-            x = DecoderBlock(
+            x = block_cls(
                 self.num_heads, self.mlp_dim, dtype=self.dtype,
                 dropout=self.dropout, attn_fn=self.attn_fn,
                 use_rope=self.use_rope, decode=self.decode, name=f"block{i}",
-            )(x, train=train)
+            )(x, train)
         x = nn.LayerNorm(dtype=self.dtype, name="final_ln")(x)
         if self.tie_embeddings:
             logits = embed.attend(x)  # h @ E^T

@@ -73,7 +73,8 @@ class EncoderBlock(nn.Module):
     gelu_exact: bool = False
 
     @nn.compact
-    def __call__(self, x, *, train: bool):
+    def __call__(self, x, train: bool = True):
+        # train is positional-or-keyword so nn.remat can mark it static
         y = nn.LayerNorm(dtype=self.dtype)(x)
         y = MultiHeadAttention(self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn)(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
@@ -109,6 +110,9 @@ class ViT(nn.Module):
     # stay mean-pool + tanh GELU — the SP-shardable, TPU-fast form.
     use_class_token: bool = False
     gelu_exact: bool = False
+    # rematerialize each encoder block in the backward pass (activation
+    # memory O(1 block) for ~1 extra forward of FLOPs)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -134,12 +138,15 @@ class ViT(nn.Module):
         )
         x = x + pos.astype(self.dtype)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        from .common import maybe_remat
+
+        block_cls = maybe_remat(EncoderBlock, self.remat, train_argnum=2)
         for i in range(self.depth):
-            x = EncoderBlock(
+            x = block_cls(
                 self.num_heads, self.mlp_dim, dtype=self.dtype,
                 dropout=self.dropout, attn_fn=self.attn_fn,
                 gelu_exact=self.gelu_exact, name=f"block{i}",
-            )(x, train=train)
+            )(x, train)
         x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
         x = x[:, 0] if self.use_class_token else x.mean(axis=1)
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
