@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""LM sampling CLI — generate text from a trained checkpoint.
+
+The LM-side analog of ``bin/infer.py`` (the reference's inference demo
+is vision-only, bin/pluto.jl:338-382): loads an orbax checkpoint
+produced by ``bin/driver.py --model lm_*``, rebuilds the model in
+``decode=True`` KV-cache mode, and samples from a prompt — byte-level
+prompts/outputs for ``text:`` corpora (vocab 256), integer token
+prompts otherwise.
+
+    # train, then sample from the same checkpoint dir
+    python bin/driver.py --model lm_tiny --dataset text:corpus.txt \
+        --seqlen 128 --batch-size 64 --epochs 2 --checkpoint-dir ck/
+    python bin/generate.py --model lm_tiny --checkpoint ck/ \
+        --prompt "The quick" --length 200 --temperature 0.8
+
+    # no checkpoint -> random-init demo (structure smoke test)
+    python bin/generate.py --model lm_tiny --vocab 64 --prompt-tokens 3,1,4
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--model", default="lm_tiny",
+                   help="lm factory name in fluxdistributed_tpu.models "
+                        "(lm_tiny/lm_small/lm_medium)")
+    p.add_argument("--vocab", type=int, default=256,
+                   help="vocab size (256 = byte-level, text: corpora)")
+    p.add_argument("--checkpoint", default=None,
+                   help="checkpoint dir from the trainer (latest step used; "
+                        "random init if omitted)")
+    p.add_argument("--step", type=int, default=None, help="specific checkpoint step")
+    p.add_argument("--prompt", default=None,
+                   help="text prompt, encoded as UTF-8 bytes (needs vocab>=256)")
+    p.add_argument("--prompt-tokens", default=None,
+                   help="comma-separated integer token prompt")
+    p.add_argument("--length", type=int, default=128,
+                   help="total sequence length incl. the prompt")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy; >0 samples")
+    p.add_argument("--seed", type=int, default=0, help="sampling seed")
+    p.add_argument("--platform", default=None,
+                   help="force platform (e.g. cpu)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import numpy as np
+
+    from fluxdistributed_tpu import models
+
+    if args.prompt is not None and args.prompt_tokens is not None:
+        raise SystemExit("pass --prompt OR --prompt-tokens, not both")
+    if args.prompt is not None:
+        if args.vocab < 256:
+            raise SystemExit("--prompt is byte-encoded; needs --vocab >= 256")
+        prompt = np.frombuffer(args.prompt.encode("utf-8"), np.uint8).astype(np.int32)
+    elif args.prompt_tokens is not None:
+        prompt = np.asarray([int(t) for t in args.prompt_tokens.split(",")], np.int32)
+        if prompt.min() < 0 or prompt.max() >= args.vocab:
+            raise SystemExit(f"prompt tokens must be in [0, {args.vocab})")
+    else:
+        prompt = np.zeros(1, np.int32)
+    if not (0 < len(prompt) < args.length):
+        raise SystemExit(
+            f"prompt length {len(prompt)} must be in (0, --length {args.length})"
+        )
+
+    model_fn = getattr(models, args.model)
+    dm = model_fn(vocab=args.vocab, decode=True)
+    train_model = model_fn(vocab=args.vocab)
+
+    if args.checkpoint:
+        from fluxdistributed_tpu.train import load_checkpoint
+
+        restored = load_checkpoint(args.checkpoint, step=args.step)
+        params = restored["params"]
+        print(f"loaded checkpoint step "
+              f"{int(np.asarray(restored.get('step', -1)))} from {args.checkpoint}",
+              file=sys.stderr)
+    else:
+        params = train_model.init(
+            jax.random.PRNGKey(0), prompt[None][:, :2], train=False
+        )["params"]
+        print("no --checkpoint: sampling from a RANDOM-INIT model", file=sys.stderr)
+
+    out = models.generate(
+        dm, params, prompt[None], total_len=args.length,
+        temperature=args.temperature,
+        rng=jax.random.PRNGKey(args.seed) if args.temperature > 0 else None,
+    )
+    toks = np.asarray(out)[0]
+    if args.vocab == 256:
+        from fluxdistributed_tpu.data import ByteTextDataset
+
+        print(ByteTextDataset.decode(toks))
+    else:
+        print(",".join(str(int(t)) for t in toks))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,8 @@ from typing import Any, Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from .common import maybe_remat
+
 __all__ = [
     "ConvNeXt",
     "convnext_tiny",
@@ -108,8 +110,6 @@ class ConvNeXt(nn.Module):
         )(x)
         x = nn.LayerNorm(dtype=self.dtype, name="stem_norm")(x)
         total = sum(self.depths)
-        from .common import maybe_remat
-
         block_cls = maybe_remat(ConvNeXtBlock, self.remat, train_argnum=2)
         block = 0
         for stage, (depth, dim) in enumerate(zip(self.depths, self.dims)):

@@ -27,6 +27,8 @@ from typing import Any, Callable, Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from .common import maybe_remat
+
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152"]
 
 ModuleDef = Any
@@ -135,8 +137,6 @@ class ResNet(nn.Module):
         x = norm(name="stem_bn")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
-        from .common import maybe_remat
-
         block_cls = maybe_remat(self.block, self.remat)
         k = 0
         for i, nblocks in enumerate(self.stage_sizes):

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..ops.attention import dot_product_attention
+from .common import maybe_remat
 
 __all__ = [
     "TransformerLM",
@@ -220,8 +221,6 @@ class TransformerLM(nn.Module):
                 "pos_embedding", nn.initializers.normal(0.02), (t, self.dim)
             )
             x = x + jnp.asarray(pos_tab, self.dtype)[None]
-        from .common import maybe_remat
-
         block_cls = maybe_remat(
             DecoderBlock, self.remat and not self.decode, train_argnum=2
         )

@@ -20,6 +20,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ..ops.attention import dot_product_attention
+from .common import maybe_remat
 
 __all__ = ["ViT", "vit_tiny", "vit_b16", "vit_l16", "vit_h14"]
 
@@ -138,8 +139,6 @@ class ViT(nn.Module):
         )
         x = x + pos.astype(self.dtype)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        from .common import maybe_remat
-
         block_cls = maybe_remat(EncoderBlock, self.remat, train_argnum=2)
         for i in range(self.depth):
             x = block_cls(
