@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Transformer-LM train-step throughput: tokens/sec/chip.
+
+The LM-side companion of the headline ResNet bench (bench.py — the
+reference publishes no numbers at all, SURVEY §6, so these define the
+baseline).  Measures the compiled DP train step (fwd + bwd + implicit
+grad all-reduce + adam update, bf16 compute) on synthetic token batches
+with the shared timing protocol (``bench.time_compiled_step``), so rows
+are comparable to the ResNet numbers.
+
+    python benchmarks/lm_bench.py                       # lm_small, T=1024
+    python benchmarks/lm_bench.py --model lm_medium --seqlen 2048 --remat
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="lm_small",
+                    choices=["lm_tiny", "lm_small", "lm_medium"])
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--seqlen", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=0,
+                    help="global batch (sequences); 0 = 8/chip on TPU, 2/device on CPU")
+    ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--seconds", type=float, default=2.0)
+    ap.add_argument("--platform", default=None, help="force platform (e.g. cpu)")
+    args = ap.parse_args()
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import numpy as np
+
+    import bench
+    from fluxdistributed_tpu import mesh as mesh_lib, models, optim, sharding
+    from fluxdistributed_tpu.parallel import TrainState, make_train_step
+
+    nchips = jax.device_count()
+    platform = jax.devices()[0].platform
+    batch = args.batch or (8 if platform == "tpu" else 2) * nchips
+
+    mesh = mesh_lib.data_mesh()
+    model = getattr(models, args.model)(vocab=args.vocab, remat=args.remat)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, args.vocab, (batch, args.seqlen)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), toks[:1], train=False)["params"]
+    nparams = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+    opt = optim.adam(1e-3)
+    state = TrainState.create(sharding.replicate(params, mesh), opt)
+    step = make_train_step(models.lm_loss_fn(model), opt, mesh, donate=True)
+    b = sharding.shard_batch({"tokens": toks}, mesh)
+
+    dt, iters = bench.time_compiled_step(step, state, b, target_seconds=args.seconds)
+    tok_s_chip = batch * args.seqlen / dt / nchips
+    # decoder train step ~= 6*N FLOPs/token (fwd 2N + bwd 4N), +1 fwd if remat
+    flops_per_tok = (8 if args.remat else 6) * nparams
+    print(json.dumps({
+        "metric": f"{args.model} train-step throughput ({platform}, B={batch}, "
+                  f"T={args.seqlen}, vocab {args.vocab}"
+                  f"{', remat' if args.remat else ''})",
+        "value": round(tok_s_chip, 1),
+        "unit": "tokens/sec/chip",
+        "params_millions": round(nparams / 1e6, 1),
+        "approx_model_tflops_per_chip": round(tok_s_chip * flops_per_tok / 1e12, 2),
+        "step_ms": round(dt * 1e3, 2),
+        "iters": iters,
+    }))
+
+
+if __name__ == "__main__":
+    main()
