@@ -67,7 +67,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="resume from latest checkpoint in --checkpoint-dir")
     p.add_argument("--print-every", type=int, default=10)
     p.add_argument("--eval-every", type=int, default=50)
-    p.add_argument("--spmd", default="jit", choices=["jit", "shard_map", "fsdp"])
+    p.add_argument("--spmd", default="jit", choices=["jit", "shard_map", "fsdp", "tp"])
+    p.add_argument("--tp", type=int, default=None,
+                   help="model-axis size for --spmd tp (mesh becomes "
+                        "{data: N/tp, model: tp})")
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--wandb", action="store_true", help="log to Weights & Biases")
     # manual cluster bring-up (CPU fake cluster / debugging)
@@ -152,7 +155,18 @@ def main(argv=None) -> int:
     opt_factory = getattr(optim, args.opt)
     opt = opt_factory(lr)
 
-    mesh = fd.data_mesh()
+    if args.tp is not None and args.spmd != "tp":
+        raise SystemExit("--tp only applies with --spmd tp")
+    if args.spmd == "tp":
+        from fluxdistributed_tpu.mesh import make_mesh
+
+        ndev = jax.device_count()
+        tp = args.tp if args.tp is not None else ndev
+        if tp < 1 or ndev % tp:
+            raise SystemExit(f"--tp {tp} must be >=1 and divide {ndev} devices")
+        mesh = make_mesh({"data": ndev // tp, "model": tp})
+    else:
+        mesh = fd.data_mesh()
     if multihost.is_coordinator():
         print(
             f"devices: {jax.device_count()} ({jax.local_device_count()}/host x "

@@ -130,7 +130,51 @@ def prepare_training(
 
     if loss_fn is None:
         loss_fn = flax_loss_fn(model, loss)
-    if spmd == "fsdp":
+    if spmd == "tp":
+        # Megatron tensor parallelism over a (data, model) mesh; sharding
+        # rules picked by model family.  No rng stream threads through the
+        # TP step — fine for the default dropout=0 configs.
+        from ..models.transformer_lm import TransformerLM
+        from ..models.vit import ViT
+        from ..parallel.tp import (
+            lm_tp_rules, make_train_step_tp, param_specs, shard_state,
+            state_specs, vit_tp_rules,
+        )
+        from ..sharding import make_shardings
+
+        if accum_steps != 1:
+            raise ValueError("accum_steps > 1 requires spmd='jit' or 'fsdp'")
+        if mesh_lib.MODEL_AXIS not in mesh.shape:
+            raise ValueError(
+                "spmd='tp' needs a mesh with a 'model' axis, e.g. "
+                "make_mesh({'data': D, 'model': K})"
+            )
+        if getattr(model, "dropout", 0.0):
+            raise ValueError(
+                "spmd='tp' supports dropout=0 only (no rng stream threads "
+                "through the TP step)"
+            )
+        if isinstance(model, ViT):
+            rules = vit_tp_rules()
+        elif isinstance(model, TransformerLM):
+            rules = lm_tp_rules()
+        else:
+            raise ValueError(
+                f"no TP sharding rules for {type(model).__name__}; "
+                "spmd='tp' supports ViT and TransformerLM (CNN params are "
+                "small — use DP/FSDP there)"
+            )
+        specs = param_specs(params, rules)
+        state = TrainState.create(params, optimizer, model_state=model_state)
+        state = shard_state(state, mesh, specs)
+        step_fn = make_train_step_tp(
+            loss_fn, optimizer, mesh, specs, state, donate=donate
+        )
+        eval_fn = make_eval_step(
+            loss_fn, mesh, topk=tuple(topk),
+            state_shardings=make_shardings(state_specs(state, specs), mesh),
+        )
+    elif spmd == "fsdp":
         from ..parallel import fsdp as fsdp_lib
 
         state = TrainState.create(params, optimizer, model_state=model_state)
