@@ -43,6 +43,9 @@ __all__ = [
     "rope",
     "generate",
     "lm_pp",
+    "MoEDecoderBlock",
+    "moe_expert_fn",
+    "lm_moe_specs",
     "lm_tiny",
     "lm_small",
     "lm_medium",
@@ -185,6 +188,61 @@ class DecoderBlock(nn.Module):
         return x + y
 
 
+class MoEDecoderBlock(nn.Module):
+    """DecoderBlock with the MLP replaced by a Switch/GShard MoE layer.
+
+    ``moe_fn`` comes from ``parallel.ep.moe_apply(expert_fn, mesh, ...)``
+    with the matching ``expert_fn`` being this block's per-expert MLP
+    (``w1/b1/w2/b2`` — see :func:`moe_expert_fn`): experts live sharded
+    on the ``expert`` mesh axis, tokens are dispatched by the in-block
+    router, and the load-balance auxiliary loss is sown into the
+    ``"losses"`` collection (``lm_loss_fn`` adds it, weighted by the
+    model's ``moe_aux_weight``).
+    """
+
+    num_heads: int
+    mlp_dim: int
+    num_experts: int
+    moe_fn: Callable
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.0
+    attn_fn: Optional[AttnFn] = None
+    use_rope: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = CausalSelfAttention(
+            self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn,
+            use_rope=self.use_rope,
+        )(y)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        b, t, d = y.shape
+        e, m = self.num_experts, self.mlp_dim
+        init = nn.initializers.lecun_normal()
+        router = self.param("router", init, (d, e), jnp.float32)
+        experts = {
+            "w1": self.param("w1", init, (e, d, m), jnp.float32),
+            "b1": self.param("b1", nn.initializers.zeros, (e, m), jnp.float32),
+            "w2": self.param("w2", init, (e, m, d), jnp.float32),
+            "b2": self.param("b2", nn.initializers.zeros, (e, d), jnp.float32),
+        }
+        experts = jax.tree.map(lambda p: jnp.asarray(p, self.dtype), experts)
+        toks = y.reshape(b * t, d)
+        out, aux = self.moe_fn(experts, jnp.asarray(router, jnp.float32), toks)
+        self.sow("losses", "moe_aux", aux)
+        out = nn.Dropout(self.dropout, deterministic=not train)(out.reshape(b, t, d))
+        return x + out
+
+
+def moe_expert_fn(p, x):
+    """The per-expert MLP matching ``MoEDecoderBlock``'s params — pass to
+    ``parallel.ep.moe_apply`` when building the block's ``moe_fn``."""
+    return jax.nn.gelu(x @ p["w1"] + p["b1"], approximate=True) @ p["w2"] + p["b2"]
+
+
 class TransformerLM(nn.Module):
     """Decoder-only LM: tokens [B, T] int32 → logits [B, T, vocab] f32.
 
@@ -210,6 +268,16 @@ class TransformerLM(nn.Module):
     # O(depth)x less activation memory -> longer sequences / bigger
     # batches per chip (jax.checkpoint, the TPU HBM lever)
     remat: bool = False
+    # MoE: every ``moe_every``-th block swaps its MLP for a routed expert
+    # layer (0 = dense everywhere).  ``moe_fn`` is built by the caller
+    # via parallel.ep.moe_apply(models.moe_expert_fn, mesh, ...) so the
+    # expert mesh axis stays a caller decision; the router's
+    # load-balance aux loss is added by lm_loss_fn with weight
+    # ``moe_aux_weight``.
+    moe_every: int = 0
+    num_experts: int = 0
+    moe_fn: Optional[Callable] = None
+    moe_aux_weight: float = 0.01
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
@@ -221,15 +289,41 @@ class TransformerLM(nn.Module):
                 "pos_embedding", nn.initializers.normal(0.02), (t, self.dim)
             )
             x = x + jnp.asarray(pos_tab, self.dtype)[None]
+        if self.moe_every:
+            # validate up front: a silently-dense "MoE" model (moe_every >
+            # depth) or a late per-block error would mask misconfiguration
+            if self.moe_fn is None or self.num_experts < 1:
+                raise ValueError(
+                    "moe_every > 0 needs moe_fn (parallel.ep.moe_apply("
+                    "models.moe_expert_fn, mesh, ...)) and num_experts"
+                )
+            if self.moe_every > self.depth:
+                raise ValueError(
+                    f"moe_every ({self.moe_every}) > depth ({self.depth}): "
+                    "no block would be MoE"
+                )
+            if self.decode:
+                raise NotImplementedError(
+                    "decode mode for MoE blocks is not implemented"
+                )
         block_cls = maybe_remat(
             DecoderBlock, self.remat and not self.decode, train_argnum=2
         )
+        moe_cls = maybe_remat(MoEDecoderBlock, self.remat, train_argnum=2)
         for i in range(self.depth):
-            x = block_cls(
-                self.num_heads, self.mlp_dim, dtype=self.dtype,
-                dropout=self.dropout, attn_fn=self.attn_fn,
-                use_rope=self.use_rope, decode=self.decode, name=f"block{i}",
-            )(x, train)
+            if self.moe_every and (i + 1) % self.moe_every == 0:
+                x = moe_cls(
+                    self.num_heads, self.mlp_dim, self.num_experts,
+                    self.moe_fn, dtype=self.dtype, dropout=self.dropout,
+                    attn_fn=self.attn_fn, use_rope=self.use_rope,
+                    name=f"block{i}",
+                )(x, train)
+            else:
+                x = block_cls(
+                    self.num_heads, self.mlp_dim, dtype=self.dtype,
+                    dropout=self.dropout, attn_fn=self.attn_fn,
+                    use_rope=self.use_rope, decode=self.decode, name=f"block{i}",
+                )(x, train)
         x = nn.LayerNorm(dtype=self.dtype, name="final_ln")(x)
         if self.tie_embeddings:
             logits = embed.attend(x)  # h @ E^T
@@ -260,15 +354,26 @@ def lm_loss_fn(model: TransformerLM) -> Callable:
     compiled step maker — DP/FSDP/TP — accepts it unchanged.  The batch
     is ``{"tokens": [B, T]}`` with optional ``{"mask": [B, T]}``."""
 
+    moe = getattr(model, "moe_every", 0) > 0
+
     def fn(params, model_state, batch, train: bool, rng=None):
         rngs = {"dropout": rng} if (train and rng is not None) else None
-        logits = model.apply(
-            {"params": params}, batch["tokens"], train=train, rngs=rngs
-        )
-        return next_token_loss(logits, batch["tokens"], batch.get("mask")), (
-            model_state,
-            logits,
-        )
+        if moe:
+            # "losses" holds the sown per-block MoE load-balance terms
+            logits, sown = model.apply(
+                {"params": params}, batch["tokens"], train=train, rngs=rngs,
+                mutable=["losses"],
+            )
+            aux_terms = jax.tree.leaves(sown.get("losses", {}))
+        else:
+            logits = model.apply(
+                {"params": params}, batch["tokens"], train=train, rngs=rngs
+            )
+            aux_terms = []
+        loss = next_token_loss(logits, batch["tokens"], batch.get("mask"))
+        if aux_terms and train:
+            loss = loss + model.moe_aux_weight * sum(aux_terms) / len(aux_terms)
+        return loss, (model_state, logits)
 
     return fn
 
@@ -390,6 +495,12 @@ def lm_pp(
     if model.dropout:
         raise ValueError("lm_pp supports dropout=0 only (no rng stream "
                          "threads through the pipeline schedule)")
+    if model.moe_every:
+        raise ValueError(
+            "lm_pp does not support moe_every > 0: MoE and dense blocks "
+            "have different param trees, so blocks cannot stack as "
+            "homogeneous pipe stages"
+        )
     if mesh.shape[pipe_axis] != model.depth:
         raise ValueError(
             f"model.depth ({model.depth}) must equal the '{pipe_axis}' axis "
@@ -445,6 +556,23 @@ def lm_pp(
         return make_shardings(state_specs(state, p_specs), mesh)
 
     return split_params, loss_fn, state_shardings
+
+
+def lm_moe_specs(params, axis: str = "expert"):
+    """PartitionSpec tree for an MoE LM's params: expert-stacked leaves
+    (``w1/b1/w2/b2`` inside MoE blocks, leading dim E) sharded over
+    ``axis``; routers and every dense leaf replicated.  Feed through
+    ``parallel.tp.state_specs`` + ``sharding.make_shardings`` to get the
+    ``state_shardings=`` for ``make_train_step``."""
+    from jax.sharding import PartitionSpec as P
+
+    def f(kp, leaf):
+        names = [str(getattr(k, "key", getattr(k, "idx", k))) for k in kp]
+        if len(names) >= 2 and names[-1] in ("w1", "b1", "w2", "b2"):
+            return P(axis, *([None] * (leaf.ndim - 1)))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(f, params)
 
 
 def lm_tiny(vocab: int = 256, **kw) -> TransformerLM:

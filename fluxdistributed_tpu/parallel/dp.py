@@ -153,7 +153,9 @@ def make_train_step(
     masks, the same seed reproduces a run exactly.
     """
     repl = NamedSharding(mesh, P())
-    shard = NamedSharding(mesh, P(axis))
+    # axis=None: batch replicated (e.g. a pure 'expert' mesh where the
+    # MoE shard_map does its own token split)
+    shard = NamedSharding(mesh, P(axis) if axis is not None else P())
     state_sh = repl if state_shardings is None else state_shardings
     with_rng = _accepts_rng(loss_fn)
 
@@ -233,7 +235,9 @@ def make_eval_step(
     from ..ops import topkaccuracy
 
     repl = NamedSharding(mesh, P())
-    shard = NamedSharding(mesh, P(axis))
+    # axis=None: batch replicated (e.g. a pure 'expert' mesh where the
+    # MoE shard_map does its own token split)
+    shard = NamedSharding(mesh, P(axis) if axis is not None else P())
     state_sh = repl if state_shardings is None else state_shardings
 
     def step(state: TrainState, batch):
