@@ -145,6 +145,7 @@ def moe_apply(
     capacity: Optional[int] = None,
     top_k: int = 1,
     routing: str = "token",
+    batch_axis: Optional[str] = None,
 ):
     """Build ``fn(stacked_params, router_w, x) -> (y, aux)``.
 
@@ -158,18 +159,26 @@ def moe_apply(
     with ``top_k`` = 1 Switch / >1 GShard-style) or expert-choice
     (``"expert_choice"``: each expert takes its top-C tokens; perfectly
     balanced, aux = 0).
+
+    ``batch_axis`` composes data parallelism on a ``(data, expert)``
+    mesh: the token dim is sharded over BOTH axes, each data row routes
+    its own tokens among that row's expert shards (expert weights are
+    replicated across rows; their gradient all-reduce over ``data`` is
+    AD's transpose of that replication), and the dispatch ``all_to_all``
+    stays within the row.
     """
     if routing not in ("token", "expert_choice"):
         raise ValueError(f"unknown routing {routing!r}")
     if routing == "expert_choice" and top_k != 1:
         raise ValueError("top_k applies to token-choice routing only")
     e_devices = mesh.shape[axis]
+    tok_spec = P((batch_axis, axis)) if batch_axis else P(axis)
 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(axis), P(), P(axis)),
-        out_specs=(P(axis), P()),
+        in_specs=(P(axis), P(), tok_spec),
+        out_specs=(tok_spec, P()),
     )
     def run(stacked_params, router_w, x):
         t, d = x.shape
@@ -205,6 +214,9 @@ def moe_apply(
         # route results back to the token-owning shards
         y = jax.lax.all_to_all(y, axis, split_axis=0, concat_axis=0, tiled=False)
         out = jnp.einsum("ecd,tec->td", y.reshape(e, cap, d), combine)
-        return out, jax.lax.pmean(aux, axis)
+        aux = jax.lax.pmean(aux, axis)
+        if batch_axis:
+            aux = jax.lax.pmean(aux, batch_axis)
+        return out, aux
 
     return run
