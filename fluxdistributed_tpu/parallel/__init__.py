@@ -8,7 +8,7 @@ from .context import (
 )
 from .dp import TrainState, make_train_step, make_eval_step, make_train_step_shardmap
 from . import fsdp
-from .fsdp import fsdp_specs, make_train_step_fsdp, make_eval_step_fsdp
+from .fsdp import fsdp_specs, hybrid_fsdp_tp_specs, make_train_step_fsdp, make_eval_step_fsdp
 from .ep import (
     moe_apply,
     router_dispatch,
@@ -31,6 +31,7 @@ __all__ = [
     "make_train_step_shardmap",
     "fsdp",
     "fsdp_specs",
+    "hybrid_fsdp_tp_specs",
     "make_train_step_fsdp",
     "make_eval_step_fsdp",
     "ring_attention",

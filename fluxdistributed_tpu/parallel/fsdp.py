@@ -55,6 +55,7 @@ from . import dp
 __all__ = [
     "fsdp_leaf_spec",
     "fsdp_specs",
+    "hybrid_fsdp_tp_specs",
     "shard_state",
     "make_train_step_fsdp",
     "make_eval_step_fsdp",
@@ -67,7 +68,7 @@ MIN_SHARD_ELEMS = 2**11
 
 def fsdp_leaf_spec(
     shape, axis: str = mesh_lib.DATA_AXIS, nshards: int = 1,
-    min_size: int = MIN_SHARD_ELEMS,
+    min_size: int = MIN_SHARD_ELEMS, base: P | None = None,
 ) -> P:
     """PartitionSpec for one leaf, chosen from its shape alone.
 
@@ -77,23 +78,32 @@ def fsdp_leaf_spec(
     shards).  Leaves with fewer than ``min_size`` elements, or no
     divisible dim, stay replicated.
 
-    The rule is a pure function of shape, so a parameter and its
-    optimizer-state slots (momentum/Adam moments have the param's shape)
-    always agree — the property that lets one spec tree cover the whole
-    ``TrainState``.
+    ``base`` composes with an existing spec (the hybrid FSDP×TP path):
+    only dims the base leaves unsharded are candidates, and the base's
+    entries are preserved in the result.
+
+    The rule is a pure function of shape (and base), so a parameter and
+    its optimizer-state slots (momentum/Adam moments have the param's
+    shape) always agree — the property that lets one spec tree cover the
+    whole ``TrainState``.
     """
+    entries = (
+        list(base) + [None] * (len(shape) - len(base))
+        if base is not None
+        else [None] * len(shape)
+    )
+    keep = P(*entries) if base is not None else P()
     if not shape or int(np.prod(shape)) < min_size:
-        return P()
+        return keep
     best = None  # (extent, dim)
     for d, extent in enumerate(shape):
-        if extent % nshards == 0 and extent >= nshards:
+        if entries[d] is None and extent % nshards == 0 and extent >= nshards:
             if best is None or extent >= best[0]:
                 best = (extent, d)
     if best is None:
-        return P()
-    spec = [None] * len(shape)
-    spec[best[1]] = axis
-    return P(*spec)
+        return keep
+    entries[best[1]] = axis
+    return P(*entries)
 
 
 def fsdp_specs(
@@ -116,6 +126,36 @@ def fsdp_specs(
         opt_state=jax.tree.map(leaf, state.opt_state),
         model_state=jax.tree.map(lambda _: P(), state.model_state),
         step=P(),
+    )
+
+
+def hybrid_fsdp_tp_specs(
+    params,
+    mesh: Mesh,
+    tp_rules: Callable,
+    data_axis: str = mesh_lib.DATA_AXIS,
+    min_size: int = MIN_SHARD_ELEMS,
+):
+    """2-D sharding on a ``(data, model)`` mesh — the standard large-model
+    TPU recipe ("How to Scale Your Model" lineage): tensor parallelism
+    per ``tp_rules`` (e.g. ``tp.lm_tp_rules()`` — the model axis name is
+    the rules', not this function's, decision) PLUS FSDP over
+    ``data_axis`` on each leaf's largest still-unsharded dim.  Per-device
+    param/opt memory ≈ size / (|data|·|model|); XLA derives the combined
+    all-gather / reduce-scatter schedule from the annotations as usual.
+
+    Returns a PartitionSpec tree for ``params`` (feed through
+    ``tp.state_specs`` + ``sharding.make_shardings``).
+    """
+    from .tp import param_specs
+
+    n_data = mesh.shape[data_axis]
+    tp_specs = param_specs(params, tp_rules)
+    return jax.tree.map(
+        lambda spec, leaf: fsdp_leaf_spec(
+            np.shape(leaf), data_axis, n_data, min_size, base=spec
+        ),
+        tp_specs, params, is_leaf=lambda x: isinstance(x, P),
     )
 
 

@@ -182,6 +182,52 @@ def test_fsdp_checkpoint_roundtrip(setup, tmp_path):
     assert np.isfinite(np.asarray(m["loss"]))
 
 
+def test_hybrid_fsdp_tp_lm():
+    """2-D sharding on (data=2, model=4): TP rules + FSDP on the leftover
+    dim → per-device shards ~1/8 of large leaves, numerics match DP."""
+    import fluxdistributed_tpu.mesh as mesh_lib
+    from fluxdistributed_tpu.models import lm_loss_fn, lm_tiny
+    from fluxdistributed_tpu.parallel import (
+        hybrid_fsdp_tp_specs,
+        lm_tp_rules,
+        make_train_step,
+        make_train_step_tp,
+    )
+    from fluxdistributed_tpu.parallel.tp import shard_state as tp_shard_state
+
+    vocab = 32
+    model = lm_tiny(vocab=vocab, dtype=jnp.float32)
+    toks = np.random.default_rng(11).integers(0, vocab, (16, 24)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), toks[:2], train=False)["params"]
+    opt = optim.momentum(0.05, 0.9)
+    loss_fn = lm_loss_fn(model)
+
+    mesh = mesh_lib.make_mesh({"data": 2, "model": 4})
+    specs = hybrid_fsdp_tp_specs(params, mesh, lm_tp_rules(), min_size=64)
+    # embedding: vocab over model (TP) + dim over data (FSDP)
+    assert specs["embed"]["embedding"] == P("model", "data")
+    qkv = specs["block0"]["CausalSelfAttention_0"]["qkv"]["kernel"]
+    assert qkv == P("data", None, "model", None)
+
+    hy_state = tp_shard_state(TrainState.create(params, opt), mesh, specs)
+    qkv_leaf = hy_state.params["block0"]["CausalSelfAttention_0"]["qkv"]["kernel"]
+    assert qkv_leaf.addressable_shards[0].data.size == qkv_leaf.size // 8
+    hy_step = make_train_step_tp(loss_fn, opt, mesh, specs, hy_state, donate=False)
+    b_hy = sharding.shard_batch({"tokens": toks}, mesh, axis="data")
+
+    dp_mesh = mesh_lib.data_mesh(8)
+    dp_state = TrainState.create(sharding.replicate(params, dp_mesh), opt)
+    dp_step = make_train_step(loss_fn, opt, dp_mesh, donate=False)
+    b_dp = sharding.shard_batch({"tokens": toks}, dp_mesh)
+
+    for _ in range(3):
+        dp_state, dp_m = dp_step(dp_state, b_dp)
+        hy_state, hy_m = hy_step(hy_state, b_hy)
+        np.testing.assert_allclose(
+            float(dp_m["loss"]), float(hy_m["loss"]), rtol=1e-5
+        )
+
+
 def test_fsdp_eval_and_accum(setup):
     mesh, params, loss_fn, batch = setup
     opt = optim.momentum(0.05, 0.9)
