@@ -67,10 +67,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="resume from latest checkpoint in --checkpoint-dir")
     p.add_argument("--print-every", type=int, default=10)
     p.add_argument("--eval-every", type=int, default=50)
-    p.add_argument("--spmd", default="jit", choices=["jit", "shard_map", "fsdp", "tp"])
+    p.add_argument("--spmd", default="jit", choices=["jit", "shard_map", "fsdp", "tp", "fsdp_tp"])
     p.add_argument("--tp", type=int, default=None,
-                   help="model-axis size for --spmd tp (mesh becomes "
-                        "{data: N/tp, model: tp})")
+                   help="model-axis size for --spmd tp / fsdp_tp (mesh "
+                        "becomes {data: N/tp, model: tp}; required for "
+                        "fsdp_tp, defaults to all devices for tp)")
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--wandb", action="store_true", help="log to Weights & Biases")
     # manual cluster bring-up (CPU fake cluster / debugging)
@@ -155,12 +156,17 @@ def main(argv=None) -> int:
     opt_factory = getattr(optim, args.opt)
     opt = opt_factory(lr)
 
-    if args.tp is not None and args.spmd != "tp":
-        raise SystemExit("--tp only applies with --spmd tp")
-    if args.spmd == "tp":
+    if args.tp is not None and args.spmd not in ("tp", "fsdp_tp"):
+        raise SystemExit("--tp only applies with --spmd tp or fsdp_tp")
+    if args.spmd in ("tp", "fsdp_tp"):
         from fluxdistributed_tpu.mesh import make_mesh
 
         ndev = jax.device_count()
+        if args.spmd == "fsdp_tp" and (args.tp is None or args.tp >= ndev):
+            raise SystemExit(
+                "--spmd fsdp_tp needs --tp < device count: with no data-axis "
+                "extent there is nothing for FSDP to shard over"
+            )
         tp = args.tp if args.tp is not None else ndev
         if tp < 1 or ndev % tp:
             raise SystemExit(f"--tp {tp} must be >=1 and divide {ndev} devices")

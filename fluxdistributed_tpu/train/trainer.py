@@ -130,10 +130,12 @@ def prepare_training(
 
     if loss_fn is None:
         loss_fn = flax_loss_fn(model, loss)
-    if spmd == "tp":
+    if spmd in ("tp", "fsdp_tp"):
         # Megatron tensor parallelism over a (data, model) mesh; sharding
-        # rules picked by model family.  No rng stream threads through the
-        # TP step — fine for the default dropout=0 configs.
+        # rules picked by model family ("fsdp_tp" additionally
+        # FSDP-shards each large leaf's leftover dim over the data axis —
+        # the hybrid 2-D recipe).  No rng stream threads through the TP
+        # step — fine for the default dropout=0 configs.
         from ..models.transformer_lm import TransformerLM
         from ..models.vit import ViT
         from ..parallel.tp import (
@@ -146,13 +148,13 @@ def prepare_training(
             raise ValueError("accum_steps > 1 requires spmd='jit' or 'fsdp'")
         if mesh_lib.MODEL_AXIS not in mesh.shape:
             raise ValueError(
-                "spmd='tp' needs a mesh with a 'model' axis, e.g. "
+                f"spmd={spmd!r} needs a mesh with a 'model' axis, e.g. "
                 "make_mesh({'data': D, 'model': K})"
             )
         if getattr(model, "dropout", 0.0):
             raise ValueError(
-                "spmd='tp' supports dropout=0 only (no rng stream threads "
-                "through the TP step)"
+                f"spmd={spmd!r} supports dropout=0 only (no rng stream "
+                "threads through the TP step)"
             )
         if isinstance(model, ViT):
             rules = vit_tp_rules()
@@ -161,10 +163,15 @@ def prepare_training(
         else:
             raise ValueError(
                 f"no TP sharding rules for {type(model).__name__}; "
-                "spmd='tp' supports ViT and TransformerLM (CNN params are "
-                "small — use DP/FSDP there)"
+                f"spmd={spmd!r} supports ViT and TransformerLM (CNN params "
+                "are small — use DP/FSDP there)"
             )
-        specs = param_specs(params, rules)
+        if spmd == "fsdp_tp":
+            from ..parallel.fsdp import hybrid_fsdp_tp_specs
+
+            specs = hybrid_fsdp_tp_specs(params, mesh, rules)
+        else:
+            specs = param_specs(params, rules)
         state = TrainState.create(params, optimizer, model_state=model_state)
         state = shard_state(state, mesh, specs)
         step_fn = make_train_step_tp(
