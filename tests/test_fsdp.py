@@ -228,6 +228,38 @@ def test_hybrid_fsdp_tp_lm():
         )
 
 
+def test_fsdp_tp_through_trainer():
+    """The user path for the hybrid 2-D recipe: prepare_training(
+    spmd='fsdp_tp') shards state over BOTH axes and training learns."""
+    import fluxdistributed_tpu.mesh as mesh_lib
+    from fluxdistributed_tpu.data import SyntheticTextDataset
+    from fluxdistributed_tpu.models import lm_loss_fn, lm_tiny
+    from fluxdistributed_tpu.train import prepare_training, train
+    from fluxdistributed_tpu.train.logging import NullLogger
+
+    mesh = mesh_lib.make_mesh({"data": 2, "model": 4})
+    model = lm_tiny(vocab=32, dtype=jnp.float32)
+    ds = SyntheticTextDataset(vocab=32, seqlen=32, peak=0.9)
+    task = prepare_training(
+        model, ds, optim.adam(3e-3), mesh=mesh, batch_size=32, cycles=30,
+        loss_fn=lm_loss_fn(model), topk=(), spmd="fsdp_tp",
+    )
+    emb = task.state.params["embed"]["embedding"]
+    assert emb.sharding.spec == P("model", "data")
+    assert emb.addressable_shards[0].data.size == emb.size // 8
+    losses = []
+    orig = task.step_fn
+
+    def rec(state, batch):
+        out = orig(state, batch)
+        losses.append(float(out[1]["loss"]))
+        return out
+
+    task.step_fn = rec
+    train(task, print_every=0, eval_every=0, topk=(), logger=NullLogger())
+    assert losses[-1] < losses[0]
+
+
 def test_fsdp_eval_and_accum(setup):
     mesh, params, loss_fn, batch = setup
     opt = optim.momentum(0.05, 0.9)
