@@ -43,6 +43,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="total sequence length incl. the prompt")
     p.add_argument("--temperature", type=float, default=0.0,
                    help="0 = greedy; >0 samples")
+    p.add_argument("--top-k", type=int, default=0,
+                   help="keep only the k highest logits (0 = off)")
+    p.add_argument("--top-p", type=float, default=1.0,
+                   help="nucleus sampling threshold (1.0 = off)")
     p.add_argument("--seed", type=int, default=0, help="sampling seed")
     p.add_argument("--platform", default=None,
                    help="force platform (e.g. cpu)")
@@ -96,7 +100,7 @@ def main(argv=None) -> int:
 
     out = models.generate(
         dm, params, prompt[None], total_len=args.length,
-        temperature=args.temperature,
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         rng=jax.random.PRNGKey(args.seed) if args.temperature > 0 else None,
     )
     toks = np.asarray(out)[0]

@@ -385,6 +385,8 @@ def generate(
     total_len: int,
     temperature: float = 0.0,
     rng=None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ):
     """Autoregressive sampling with the KV cache, as ONE compiled program.
 
@@ -394,8 +396,10 @@ def generate(
     full-width forward (writing all P keys/values into the cache at
     once), then a ``lax.scan`` of single-token cache steps samples out
     to ``total_len``: greedy at ``temperature=0``, else softmax
-    sampling with ``rng``.  Static shapes throughout — one compile per
-    (B, P, total_len).
+    sampling with ``rng``.  ``top_k`` keeps only the k highest logits
+    and ``top_p`` keeps the smallest nucleus with cumulative probability
+    >= p (both compose with temperature; 0 / 1.0 disable).  Static
+    shapes throughout — one compile per (B, P, total_len).
 
     Returns tokens [B, total_len] (prompt included).
     """
@@ -411,6 +415,11 @@ def generate(
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature > 0 samples stochastically — pass rng "
                          "(a jax.random.PRNGKey) or use temperature=0 for greedy")
+    if top_k < 0 or not (0.0 < top_p <= 1.0):
+        raise ValueError(f"need top_k >= 0 and 0 < top_p <= 1, got {top_k}, {top_p}")
+    if (top_k or top_p < 1.0) and temperature == 0.0:
+        raise ValueError("top_k/top_p filter a sampling distribution — "
+                         "set temperature > 0 (greedy ignores them)")
     # cache shapes from an abstract init trace of the FULL length — no
     # forward pass, no throwaway parameter materialization
     spec = jax.eval_shape(
@@ -421,12 +430,36 @@ def generate(
     cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), spec)
     key = rng if rng is not None else jax.random.PRNGKey(0)
 
+    vocab = model.vocab
+    k_eff = top_k if 0 < top_k < vocab else 0  # k >= V keeps everything
+
     def sample(logits, sub):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            sub, logits / temperature, axis=-1
-        ).astype(jnp.int32)
+        # filter math in f32: a bf16 cumsum rounds tail probabilities
+        # away and saturates below 1.0, silently disabling the nucleus
+        # cutoff at realistic vocab sizes (same reason the loss path
+        # upcasts its log-softmax)
+        logits = logits.astype(jnp.float32) / temperature
+        if k_eff or top_p < 1.0:
+            # ONE descending sort serves both filters
+            sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+            cutoff = jnp.full((logits.shape[0], 1), -jnp.inf, jnp.float32)
+            if k_eff:
+                cutoff = sorted_logits[:, k_eff - 1 : k_eff]
+            if top_p < 1.0:
+                # nucleus: keep the smallest prefix (by descending prob)
+                # with cumulative probability >= top_p; the first token
+                # past the threshold stays in (inclusive convention)
+                probs = jax.nn.softmax(sorted_logits, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                keep = cum - probs < top_p
+                p_cut = jnp.min(
+                    jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+                )
+                cutoff = jnp.maximum(cutoff, p_cut)
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        return jax.random.categorical(sub, logits, axis=-1).astype(jnp.int32)
 
     # prefill: one parallel pass over the whole prompt
     logits_p, mut = model.apply(

@@ -201,6 +201,44 @@ def test_generate_follows_markov_chain():
             assert b_ == succ[a], (row, succ[a], a, b_)
 
 
+def test_generate_top_k_top_p():
+    """top_k=1 at any temperature is greedy; top_p near 0 likewise; bad
+    filter configs are rejected."""
+    from fluxdistributed_tpu.models import generate
+
+    dm = lm_tiny(vocab=VOCAB, dtype=jnp.float32, decode=True)
+    params = lm_tiny(vocab=VOCAB, dtype=jnp.float32).init(
+        jax.random.PRNGKey(0), np.zeros((1, 2), np.int32), train=False
+    )["params"]
+    prompt = np.asarray([[3, 7]], np.int32)
+    greedy = np.asarray(generate(dm, params, prompt, 10))
+    k1 = np.asarray(generate(
+        dm, params, prompt, 10, temperature=1.5, top_k=1,
+        rng=jax.random.PRNGKey(0),
+    ))
+    np.testing.assert_array_equal(greedy, k1)
+    p_tiny = np.asarray(generate(
+        dm, params, prompt, 10, temperature=1.5, top_p=1e-6,
+        rng=jax.random.PRNGKey(1),
+    ))
+    np.testing.assert_array_equal(greedy, p_tiny)
+    # top_k >= vocab keeps everything == plain sampling
+    plain = np.asarray(generate(
+        dm, params, prompt, 10, temperature=1.0, rng=jax.random.PRNGKey(2),
+    ))
+    k_all = np.asarray(generate(
+        dm, params, prompt, 10, temperature=1.0, top_k=10 * VOCAB,
+        rng=jax.random.PRNGKey(2),
+    ))
+    np.testing.assert_array_equal(plain, k_all)
+    # filters without sampling make no sense
+    with pytest.raises(ValueError, match="temperature"):
+        generate(dm, params, prompt, 10, top_k=5)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(dm, params, prompt, 10, temperature=1.0, top_p=0.0,
+                 rng=jax.random.PRNGKey(0))
+
+
 def test_generate_rejects_bad_config(model_and_params):
     from fluxdistributed_tpu.models import generate
 
