@@ -41,6 +41,10 @@ def test_build_step_variant_knobs(bench_mod):
     assert float(m["loss"]) > 0
     assert b["image"].dtype == jnp.float32
 
+    step, state, b = bench_mod.build_step(batch=8, size=32, donate=False, remat=True)
+    _, m = step(state, b)
+    assert float(m["loss"]) > 0
+
 
 def test_main_emits_error_json_and_rc0_on_failure(bench_mod, monkeypatch, capsys):
     def boom():
