@@ -20,9 +20,8 @@ plus programmatic registration (``register_dataset``) and
 
 from __future__ import annotations
 
-import os
 import tomllib
-from typing import Any, Callable, Optional
+from typing import Any, Callable
 
 __all__ = ["register_dataset", "open_dataset", "load_registry", "DRIVERS"]
 

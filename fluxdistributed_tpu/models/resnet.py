@@ -22,7 +22,7 @@ equivalence (test/single_device.jl:51-58).  Here there are two modes:
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Sequence
+from typing import Any, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp

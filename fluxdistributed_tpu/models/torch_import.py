@@ -17,7 +17,7 @@ accepted.  Load .pt/.pth files with ``load_torch_file`` (requires torch).
 
 from __future__ import annotations
 
-from typing import Any, Mapping, Sequence
+from typing import Any, Mapping
 
 import numpy as np
 

@@ -32,7 +32,7 @@ machinery without GPUs (test/single_device.jl:121-151).
 from __future__ import annotations
 
 import os
-from typing import Any, Optional, Sequence
+from typing import Any, Optional
 
 import jax
 import numpy as np

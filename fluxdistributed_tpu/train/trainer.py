@@ -38,7 +38,7 @@ from .. import mesh as mesh_lib
 from .. import sharding as sharding_lib
 from .. import tree as tree_lib
 from ..data.loader import PrefetchLoader
-from ..ops import logitcrossentropy, onehot
+from ..ops import logitcrossentropy
 from ..optim import Optimizer
 from ..parallel.dp import TrainState, flax_loss_fn, make_eval_step, make_train_step
 from .logging import Logger, current_logger

@@ -27,7 +27,7 @@ Every function is pure and jit-compatible unless documented otherwise.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, Sequence
+from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
