@@ -208,13 +208,14 @@ class MoEDecoderBlock(nn.Module):
     dropout: float = 0.0
     attn_fn: Optional[AttnFn] = None
     use_rope: bool = True
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         y = nn.LayerNorm(dtype=self.dtype)(x)
         y = CausalSelfAttention(
             self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn,
-            use_rope=self.use_rope,
+            use_rope=self.use_rope, decode=self.decode,
         )(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -302,21 +303,23 @@ class TransformerLM(nn.Module):
                     f"moe_every ({self.moe_every}) > depth ({self.depth}): "
                     "no block would be MoE"
                 )
-            if self.decode:
-                raise NotImplementedError(
-                    "decode mode for MoE blocks is not implemented"
-                )
+            # decode note: each cache step routes only B tokens (not a
+            # mesh multiple) — build the decode moe_fn with
+            # pad_tokens=True and an explicit capacity sized for B plus
+            # padding headroom (moe_apply enforces both)
         block_cls = maybe_remat(
             DecoderBlock, self.remat and not self.decode, train_argnum=2
         )
-        moe_cls = maybe_remat(MoEDecoderBlock, self.remat, train_argnum=2)
+        moe_cls = maybe_remat(
+            MoEDecoderBlock, self.remat and not self.decode, train_argnum=2
+        )
         for i in range(self.depth):
             if self.moe_every and (i + 1) % self.moe_every == 0:
                 x = moe_cls(
                     self.num_heads, self.mlp_dim, self.num_experts,
                     self.moe_fn, dtype=self.dtype, dropout=self.dropout,
                     attn_fn=self.attn_fn, use_rope=self.use_rope,
-                    name=f"block{i}",
+                    decode=self.decode, name=f"block{i}",
                 )(x, train)
             else:
                 x = block_cls(

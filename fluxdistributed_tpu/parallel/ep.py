@@ -146,6 +146,7 @@ def moe_apply(
     top_k: int = 1,
     routing: str = "token",
     batch_axis: Optional[str] = None,
+    pad_tokens: bool = False,
 ):
     """Build ``fn(stacked_params, router_w, x) -> (y, aux)``.
 
@@ -171,6 +172,16 @@ def moe_apply(
         raise ValueError(f"unknown routing {routing!r}")
     if routing == "expert_choice" and top_k != 1:
         raise ValueError("top_k applies to token-choice routing only")
+    if pad_tokens and routing == "expert_choice":
+        # pad tokens get uniform router prob 1/E and would displace real
+        # tokens from each expert's top-capacity pick
+        raise ValueError("pad_tokens is incompatible with expert_choice routing")
+    if pad_tokens and capacity is None:
+        raise ValueError(
+            "pad_tokens=True needs an explicit capacity: the auto capacity "
+            "ceil(T/E * factor) is ~1 for tiny decode steps and pad tokens "
+            "consume slots — size it for the real token count plus headroom"
+        )
     e_devices = mesh.shape[axis]
     tok_spec = P((batch_axis, axis)) if batch_axis else P(axis)
 
@@ -219,4 +230,26 @@ def moe_apply(
             aux = jax.lax.pmean(aux, batch_axis)
         return out, aux
 
-    return run
+    n_shards = e_devices * (mesh.shape[batch_axis] if batch_axis else 1)
+
+    def fn(stacked_params, router_w, x):
+        t = x.shape[0]
+        pad = (-t) % n_shards
+        if pad and not pad_tokens:
+            raise ValueError(
+                f"token count {t} is not divisible by the mesh's {n_shards} "
+                "shards. For training this usually means a batch/mesh "
+                "misconfiguration; for small decode steps build the moe_fn "
+                "with pad_tokens=True and an explicit capacity"
+            )
+        if pad:
+            # zero tokens route like any other (uniform router prob) and
+            # occupy capacity slots + appear in the aux statistics — the
+            # explicit-capacity requirement above keeps real tokens safe
+            x = jnp.concatenate(
+                [x, jnp.zeros((pad, x.shape[1]), x.dtype)], axis=0
+            )
+        y, aux = run(stacked_params, router_w, x)
+        return (y[:t] if pad else y), aux
+
+    return fn
