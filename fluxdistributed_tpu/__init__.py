@@ -13,7 +13,7 @@ The package targets full parity with the reference's exported surface
 below are the currently implemented subset.
 """
 
-from . import data, mesh, optim, sharding, tree
+from . import data, mesh, models, ops, optim, parallel, sharding, train, tree
 from .data import (
     labels,
     load_registry,
@@ -40,8 +40,12 @@ __version__ = "0.1.0"
 __all__ = [
     "data",
     "mesh",
+    "models",
+    "ops",
     "optim",
+    "parallel",
     "sharding",
+    "train",
     "tree",
     "labels",
     "load_registry",
