@@ -13,7 +13,20 @@ The package targets full parity with the reference's exported surface
 below are the currently implemented subset.
 """
 
-from . import data, mesh, models, ops, optim, parallel, sharding, train, tree
+from . import data, mesh, models, ops, optim, parallel, sharding, tree
+
+
+def __getattr__(name):
+    # ``train`` is lazy (PEP 562): it imports orbax.checkpoint, which
+    # costs seconds at startup that data/mesh/ops-only consumers never
+    # need to pay
+    if name == "train":
+        import importlib
+
+        mod = importlib.import_module(".train", __name__)
+        globals()["train"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 from .data import (
     labels,
     load_registry,

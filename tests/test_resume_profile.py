@@ -74,6 +74,36 @@ class _CaptureLogger:
         pass
 
 
+def test_tp_sharded_resume(tmp_path):
+    """spmd='tp' checkpoints save model-sharded and restore model-sharded
+    (the abstract-target path), then training continues."""
+    from jax.sharding import PartitionSpec as P
+
+    from fluxdistributed_tpu.data import SyntheticTextDataset
+    from fluxdistributed_tpu.models import lm_loss_fn, lm_tiny
+    from fluxdistributed_tpu.train import restore_training
+
+    mesh = mesh_lib.make_mesh({"data": 2, "model": 4})
+    model = lm_tiny(vocab=32, dtype=np.float32)
+    ds = SyntheticTextDataset(vocab=32, seqlen=32)
+
+    def mk(cycles):
+        return prepare_training(
+            model, ds, optim.adam(1e-3), mesh=mesh, batch_size=16,
+            cycles=cycles, loss_fn=lm_loss_fn(model), topk=(), spmd="tp",
+        )
+
+    task = mk(4)
+    train(task, print_every=0, eval_every=0, logger=NullLogger(),
+          checkpoint_dir=str(tmp_path), checkpoint_every=2)
+
+    task2 = restore_training(mk(3), str(tmp_path))
+    emb = task2.state.params["embed"]["embedding"]
+    assert emb.sharding.spec == P("model", None)
+    assert int(task2.state.step) > 0
+    train(task2, print_every=0, eval_every=0, logger=NullLogger())
+
+
 def test_async_checkpoint_commits(mesh, tmp_path):
     """block=False saves must survive state mutation after the call (the
     device→host snapshot is synchronous) and be fully on disk after
