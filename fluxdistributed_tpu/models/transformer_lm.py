@@ -523,7 +523,7 @@ def lm_pp(
     """
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.pp import pipeline_apply, stack_stage_params
+    from ..parallel.pp import chunk_stages, pipeline_apply, stack_stage_params
 
     if not model.use_rope:
         raise ValueError("lm_pp needs use_rope=True (a positional table "
@@ -537,19 +537,24 @@ def lm_pp(
             "have different param trees, so blocks cannot stack as "
             "homogeneous pipe stages"
         )
-    if mesh.shape[pipe_axis] != model.depth:
+    S = mesh.shape[pipe_axis]
+    if model.depth % S:
         raise ValueError(
-            f"model.depth ({model.depth}) must equal the '{pipe_axis}' axis "
-            f"size ({mesh.shape[pipe_axis]}); use chunk_stages for V>1 "
-            "blocks per device"
+            f"model.depth ({model.depth}) must be a multiple of the "
+            f"'{pipe_axis}' axis size ({S})"
         )
+    V = model.depth // S  # logical blocks hosted per pipe device
 
     blk = DecoderBlock(
         model.num_heads, model.mlp_dim, dtype=model.dtype,
         dropout=0.0, use_rope=model.use_rope, attn_fn=model.attn_fn,
     )
+
+    def base_fn(p, x):
+        return blk.apply({"params": p}, x, train=False)
+
     fwd = pipeline_apply(
-        lambda p, x: blk.apply({"params": p}, x, train=False),
+        base_fn if V == 1 else chunk_stages(base_fn),
         mesh, axis=pipe_axis, num_microbatches=num_microbatches,
         batch_axis=batch_axis,
     )
@@ -559,6 +564,13 @@ def lm_pp(
     def split_params(params):
         stages = [params[f"block{i}"] for i in range(model.depth)]
         outer = {k: v for k, v in params.items() if not k.startswith("block")}
+        if V > 1:
+            # blocked virtual pipeline: device s hosts logical blocks
+            # s·V … s·V+V-1 as a (V, ...) chunk it scans over each tick
+            stages = [
+                jax.tree.map(lambda *xs: jnp.stack(xs), *stages[s * V : (s + 1) * V])
+                for s in range(S)
+            ]
         return {
             "outer": outer,
             "stages": stack_stage_params(stages, mesh, pipe_axis),
