@@ -1,5 +1,5 @@
 from .logging import ConsoleLogger, Logger, current_logger, with_logger
-from .trainer import TrainTask, prepare_training, restore_training, train
+from .trainer import TrainTask, evaluate, prepare_training, restore_training, train
 from .checkpoint import latest_step, load_checkpoint, save_checkpoint, wait_for_pending
 from .model_selection import (
     SelectionTask,
@@ -13,6 +13,7 @@ __all__ = [
     "current_logger",
     "with_logger",
     "TrainTask",
+    "evaluate",
     "prepare_training",
     "restore_training",
     "train",

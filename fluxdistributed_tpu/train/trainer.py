@@ -43,7 +43,7 @@ from ..optim import Optimizer
 from ..parallel.dp import TrainState, flax_loss_fn, make_eval_step, make_train_step
 from .logging import Logger, current_logger
 
-__all__ = ["TrainTask", "prepare_training", "train"]
+__all__ = ["TrainTask", "evaluate", "prepare_training", "train"]
 
 
 @dataclasses.dataclass
@@ -292,6 +292,83 @@ def _eval_and_log(task: TrainTask, batch, name: str, step: int, topk, logger: Lo
         metrics[f"{name}_top{k}"] = float(accs[f"top{k}"])
     logger.log(metrics, step)
     return metrics
+
+
+def evaluate(
+    task: TrainTask,
+    dataset,
+    *,
+    batch_size: int = 256,
+    max_batches: Optional[int] = None,
+    topk: Sequence[int] = (1, 5, 10),
+    seed: int = 0,
+) -> dict:
+    """Aggregate loss/top-k over a dataset with the compiled eval step —
+    beyond the reference, which only ever evals a fixed 300-sample slice
+    (src/ddp_tasks.jl:145).
+
+    Coverage semantics: when the dataset supports explicit ``indices``
+    and has a length, every sample is drawn EXACTLY once via sequential
+    index blocks (a trailing remainder smaller than one batch is
+    dropped).  Otherwise — generated token streams etc. — batches are
+    sampled and ``max_batches`` is required (the result is then a
+    stochastic estimate, flagged by ``"exact": False``).
+
+    Returns sample-weighted means ``{"loss": ..., "top1": ..., ...}``
+    plus ``"samples"`` and ``"exact"``.  Requested top-k metrics must
+    have been compiled into the eval step (``prepare_training(topk=...)``).
+    """
+    import inspect
+
+    from ..data.loader import batch_to_dict
+
+    exact = (
+        hasattr(dataset, "__len__")
+        and "indices" in inspect.signature(dataset.batch).parameters
+    )
+    if max_batches is None:
+        if not hasattr(dataset, "__len__"):
+            raise ValueError(
+                f"{type(dataset).__name__} has no __len__; pass max_batches"
+            )
+        max_batches = max(1, len(dataset) // batch_size)
+    if exact:
+        max_batches = min(max_batches, max(1, len(dataset) // batch_size))
+    rng = np.random.default_rng(seed)
+    was_augment = getattr(dataset, "augment", False)
+    if was_augment:
+        dataset.augment = False  # eval goes through the eval pipeline
+    try:
+        total = {"loss": 0.0}
+        n = 0
+        for i in range(max_batches):
+            if exact:
+                idx = np.arange(i * batch_size, (i + 1) * batch_size)
+                draw = dataset.batch(rng, batch_size, indices=idx)
+            else:
+                draw = dataset.batch(rng, batch_size)
+            batch = sharding_lib.shard_batch(
+                batch_to_dict(draw, getattr(dataset, "nclasses", None)), task.mesh
+            )
+            loss, accs = task.eval_fn(task.state, batch)
+            total["loss"] += float(loss) * batch_size
+            for k in topk:
+                if f"top{k}" not in accs:
+                    raise KeyError(
+                        f"top-{k} accuracy was not compiled into the eval step"
+                        f" — pass topk={tuple(topk)} to prepare_training"
+                    )
+                total[f"top{k}"] = (
+                    total.get(f"top{k}", 0.0) + float(accs[f"top{k}"]) * batch_size
+                )
+            n += batch_size
+    finally:
+        if was_augment:
+            dataset.augment = True
+    out = {key: v / max(n, 1) for key, v in total.items()}
+    out["samples"] = n
+    out["exact"] = exact
+    return out
 
 
 def train(

@@ -192,3 +192,42 @@ def test_val_batch_sampled_without_augmentation(mesh):
     assert task.val_batch is not None
     assert val.augment_during_batch == [False]  # draw ran unaugmented
     assert val.augment is True  # and the flag was restored
+
+
+def test_evaluate_whole_dataset(mesh):
+    """evaluate() aggregates loss/top-k over the full dataset with the
+    compiled eval step; sample counts line up; unbounded streams need
+    max_batches."""
+    import pytest
+
+    from fluxdistributed_tpu.data import SyntheticDataset, SyntheticTextDataset
+    from fluxdistributed_tpu.models import SimpleCNN, lm_loss_fn, lm_tiny
+    from fluxdistributed_tpu.train import evaluate, prepare_training, train
+    from fluxdistributed_tpu.train.logging import NullLogger
+
+    ds = SyntheticDataset(nsamples=128, nclasses=4, shape=(8, 8, 3))
+    task = prepare_training(
+        SimpleCNN(num_classes=4), ds, optim.momentum(0.1, 0.9),
+        mesh=mesh, batch_size=16, cycles=40, topk=(1,),
+    )
+    train(task, print_every=0, eval_every=0, logger=NullLogger())
+    out = evaluate(task, ds, batch_size=32, topk=(1,))
+    assert out["samples"] == 128 and out["exact"] is True
+    assert 0.0 <= out["top1"] <= 1.0 and np.isfinite(out["loss"])
+    # asking for metrics the eval step never compiled must fail loudly
+    with pytest.raises(KeyError, match="top-5"):
+        evaluate(task, ds, batch_size=32, topk=(1, 5))
+    # trained on a learnable task -> much better than the 25% chance floor
+    assert out["top1"] > 0.8, out
+
+    lm = lm_tiny(vocab=16, dtype=np.float32)
+    tds = SyntheticTextDataset(vocab=16, seqlen=16)
+    lm_task = prepare_training(
+        lm, tds, optim.adam(1e-3), mesh=mesh, batch_size=16, cycles=1,
+        loss_fn=lm_loss_fn(lm), topk=(),
+    )
+    with pytest.raises(ValueError, match="max_batches"):
+        evaluate(lm_task, tds, batch_size=16, topk=())
+    out = evaluate(lm_task, tds, batch_size=16, max_batches=2, topk=())
+    assert out["samples"] == 32 and out["exact"] is False
+    assert np.isfinite(out["loss"])
