@@ -67,6 +67,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="resume from latest checkpoint in --checkpoint-dir")
     p.add_argument("--print-every", type=int, default=10)
     p.add_argument("--eval-every", type=int, default=50)
+    p.add_argument("--final-eval", action="store_true",
+                   help="after training, aggregate loss/top-k over the FULL "
+                        "--val-dataset with train.evaluate")
     p.add_argument("--spmd", default="jit", choices=["jit", "shard_map", "fsdp", "tp", "fsdp_tp"])
     p.add_argument("--tp", type=int, default=None,
                    help="model-axis size for --spmd tp / fsdp_tp (mesh "
@@ -137,6 +140,8 @@ def main(argv=None) -> int:
         raise SystemExit(
             f"--model {args.model} trains on tokens; use --dataset synthetic-text"
         )
+    if args.final_eval and args.val_dataset is None:
+        raise SystemExit("--final-eval needs --val-dataset")
     if is_lm:
         # LM protocol: vocab-sized model, next-token loss, no top-k image
         # metrics; cycles must be explicit (the text stream is unbounded)
@@ -219,6 +224,19 @@ def main(argv=None) -> int:
         verbose=args.verbose,
     )
     multihost.sync_global_devices("train_done")
+    if args.final_eval:
+        from fluxdistributed_tpu.train import evaluate
+
+        metrics = evaluate(
+            task, val_dataset, batch_size=args.batch_size,
+            topk=() if is_lm else (1, 5, 10),
+        )
+        if multihost.is_coordinator():
+            parts = ", ".join(
+                f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in metrics.items()
+            )
+            print(f"final eval: {parts}")
     if multihost.is_coordinator():
         print(f"done: {int(task.state.step)} steps, {task.num_missed} missed")
     return 0

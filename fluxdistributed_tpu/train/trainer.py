@@ -277,18 +277,25 @@ def _is_oom(err: Exception) -> bool:
     return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or "OOM" in s
 
 
-def _eval_and_log(task: TrainTask, batch, name: str, step: int, topk, logger: Logger):
-    """Loss + top-k accuracy on one batch — ``log_loss_and_acc``
-    (src/ddp_tasks.jl:128-148), computed entirely in the compiled eval
-    step (replicated scalar outputs, multi-host safe)."""
-    loss, accs = task.eval_fn(task.state, batch)
-    metrics = {f"{name}_loss": float(loss)}
+def _require_topk(accs: dict, topk) -> None:
+    """Fail fast when a requested top-k metric was never compiled into
+    the eval step (shared by the train-loop eval and evaluate())."""
     for k in topk:
         if f"top{k}" not in accs:
             raise KeyError(
                 f"top-{k} accuracy was not compiled into the eval step — pass "
                 f"topk={tuple(topk)} to prepare_training"
             )
+
+
+def _eval_and_log(task: TrainTask, batch, name: str, step: int, topk, logger: Logger):
+    """Loss + top-k accuracy on one batch — ``log_loss_and_acc``
+    (src/ddp_tasks.jl:128-148), computed entirely in the compiled eval
+    step (replicated scalar outputs, multi-host safe)."""
+    loss, accs = task.eval_fn(task.state, batch)
+    _require_topk(accs, topk)
+    metrics = {f"{name}_loss": float(loss)}
+    for k in topk:
         metrics[f"{name}_top{k}"] = float(accs[f"top{k}"])
     logger.log(metrics, step)
     return metrics
@@ -322,18 +329,34 @@ def evaluate(
 
     from ..data.loader import batch_to_dict
 
-    exact = (
+    capable = (
         hasattr(dataset, "__len__")
         and "indices" in inspect.signature(dataset.batch).parameters
     )
+    if capable:
+        # batch must stay shardable on the data axis AND inside the
+        # dataset; shrink it for small datasets instead of indexing past
+        # the end
+        n_axis = task.mesh.shape.get(mesh_lib.DATA_AXIS, 1)
+        max_bs = len(dataset) // n_axis * n_axis
+        if max_bs == 0:
+            raise ValueError(
+                f"dataset has {len(dataset)} samples — fewer than the "
+                f"{n_axis}-way data axis; cannot build one shardable batch"
+            )
+        batch_size = min(batch_size, max_bs)
+        full_batches = len(dataset) // batch_size
     if max_batches is None:
         if not hasattr(dataset, "__len__"):
             raise ValueError(
                 f"{type(dataset).__name__} has no __len__; pass max_batches"
             )
-        max_batches = max(1, len(dataset) // batch_size)
-    if exact:
-        max_batches = min(max_batches, max(1, len(dataset) // batch_size))
+        max_batches = full_batches if capable else max(1, len(dataset) // batch_size)
+    if capable:
+        max_batches = min(max_batches, full_batches)
+    # "exact" promises once-per-sample coverage of every full batch — a
+    # caller-truncated run is a sampled estimate of a different kind
+    exact = capable and max_batches == full_batches
     rng = np.random.default_rng(seed)
     was_augment = getattr(dataset, "augment", False)
     if was_augment:
@@ -351,13 +374,10 @@ def evaluate(
                 batch_to_dict(draw, getattr(dataset, "nclasses", None)), task.mesh
             )
             loss, accs = task.eval_fn(task.state, batch)
+            if i == 0:
+                _require_topk(accs, topk)
             total["loss"] += float(loss) * batch_size
             for k in topk:
-                if f"top{k}" not in accs:
-                    raise KeyError(
-                        f"top-{k} accuracy was not compiled into the eval step"
-                        f" — pass topk={tuple(topk)} to prepare_training"
-                    )
                 total[f"top{k}"] = (
                     total.get(f"top{k}", 0.0) + float(accs[f"top{k}"]) * batch_size
                 )
