@@ -217,6 +217,13 @@ def test_evaluate_whole_dataset(mesh):
     # asking for metrics the eval step never compiled must fail loudly
     with pytest.raises(KeyError, match="top-5"):
         evaluate(task, ds, batch_size=32, topk=(1, 5))
+    # batch bigger than the dataset: clamp to a shardable size, stay exact
+    small = SyntheticDataset(nsamples=24, nclasses=4, shape=(8, 8, 3))
+    out_small = evaluate(task, small, batch_size=256, topk=(1,))
+    assert out_small["samples"] == 24 and out_small["exact"] is True
+    # truncated coverage is honestly flagged
+    out_trunc = evaluate(task, ds, batch_size=32, max_batches=1, topk=(1,))
+    assert out_trunc["samples"] == 32 and out_trunc["exact"] is False
     # trained on a learnable task -> much better than the 25% chance floor
     assert out["top1"] > 0.8, out
 
