@@ -47,13 +47,39 @@ def test_build_step_variant_knobs(bench_mod):
 
 
 def test_main_emits_error_json_and_rc0_on_failure(bench_mod, monkeypatch, capsys):
-    def boom():
-        raise RuntimeError("injected failure")
+    """main() must print the JSON line and return normally no matter how
+    the measurement subprocess dies — crash, hang (TimeoutExpired), or
+    garbage output (the 2026-07-30 unavailable-backend scenario)."""
+    import subprocess
 
-    monkeypatch.setattr(bench_mod, "_measure", boom)
+    def boom(*a, **kw):
+        raise subprocess.TimeoutExpired(cmd="bench", timeout=1)
+
+    monkeypatch.setattr(subprocess, "run", boom)
     monkeypatch.setattr(bench_mod.time, "sleep", lambda s: None)
     bench_mod.main()  # must not raise
     line = capsys.readouterr().out.strip().splitlines()[-1]
     out = json.loads(line)
     assert out["unit"] == "images/sec/chip"
+    assert "timed out" in out["error"]
+
+    class FakeDone:
+        returncode = 1
+        stdout = "not json\nalso not json"
+        stderr = "injected failure"
+
+    monkeypatch.setattr(subprocess, "run", lambda *a, **kw: FakeDone())
+    bench_mod.main()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    out = json.loads(line)
     assert "injected failure" in out["error"]
+
+    class FakeOK:
+        returncode = 0
+        stdout = 'preamble\n{"metric": "m", "value": 1.0, "unit": "images/sec/chip", "vs_baseline": 1.0}'
+        stderr = ""
+
+    monkeypatch.setattr(subprocess, "run", lambda *a, **kw: FakeOK())
+    bench_mod.main()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(line)["value"] == 1.0
