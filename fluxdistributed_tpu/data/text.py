@@ -33,20 +33,25 @@ class ByteTextDataset:
         self.path = os.fspath(path)
         self.seqlen = int(seqlen)
         size = os.path.getsize(self.path)
-        if size < self.seqlen + 1:
+        if size < self.seqlen:
             raise ValueError(
-                f"{self.path}: {size} bytes < seqlen+1 ({self.seqlen + 1}) — "
-                "need at least one full window plus a next-token target"
+                f"{self.path}: {size} bytes < seqlen ({self.seqlen}) — "
+                "need at least one full window (the next-token shift happens "
+                "inside the window, so no extra target byte is required)"
             )
         # mmap: no copy of the corpus per worker thread, OS page cache
         # shared across processes on a host
         self._data = np.memmap(self.path, dtype=np.uint8, mode="r")
 
     def __len__(self) -> int:
-        return max(1, (len(self._data) - 1) // self.seqlen)
+        # non-overlapping full windows; the next-token shift is
+        # intra-window, so no trailing target byte is reserved
+        return len(self._data) // self.seqlen
 
     def batch(self, rng: np.random.Generator, n: int) -> np.ndarray:
-        starts = rng.integers(0, len(self._data) - self.seqlen, size=n)
+        # inclusive upper bound: the last valid window start is
+        # len - seqlen, so the corpus's final byte is reachable
+        starts = rng.integers(0, len(self._data) - self.seqlen + 1, size=n)
         idx = starts[:, None] + np.arange(self.seqlen)[None, :]
         return self._data[idx].astype(np.int32)
 

@@ -97,6 +97,16 @@ class CausalSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        if self.decode and self.attn_fn is not None:
+            # the KV-cache path below always attends with the dense
+            # core; silently dropping a mesh-sharded attn_fn (e.g. ring
+            # attention) would change sharding semantics without warning
+            raise ValueError(
+                "decode=True ignores attn_fn: the KV-cache path uses the "
+                "dense attention core. Generate with attn_fn=None (the "
+                "math is identical for sequence-parallel-trained weights "
+                "once gathered), or run a full forward without decode."
+            )
         b, t, d = x.shape
         assert d % self.num_heads == 0, "embed dim must divide num_heads"
         head_dim = d // self.num_heads

@@ -45,9 +45,16 @@ def _step_dir(directory: str, step: int) -> str:
 
 
 def wait_for_pending() -> None:
-    """Block until any in-flight async save has committed to disk."""
+    """Block until any in-flight async save has committed to disk.
+
+    Single-threaded savers assumed (one train loop per process — the
+    module-global ``_PENDING`` is not lock-protected).  The pending
+    reference is removed only after a successful wait, so a failed wait
+    leaves it in place and a retry can still await the write.
+    """
     while _PENDING:
-        _PENDING.pop().wait_until_finished()
+        _PENDING[-1].wait_until_finished()
+        _PENDING.pop()
 
 
 def save_checkpoint(

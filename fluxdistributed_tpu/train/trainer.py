@@ -333,11 +333,12 @@ def evaluate(
         hasattr(dataset, "__len__")
         and "indices" in inspect.signature(dataset.batch).parameters
     )
+    n_axis = task.mesh.shape.get(mesh_lib.DATA_AXIS, 1)
+    requested = batch_size
     if capable:
         # batch must stay shardable on the data axis AND inside the
         # dataset; shrink it for small datasets instead of indexing past
         # the end
-        n_axis = task.mesh.shape.get(mesh_lib.DATA_AXIS, 1)
         max_bs = len(dataset) // n_axis * n_axis
         if max_bs == 0:
             raise ValueError(
@@ -345,6 +346,15 @@ def evaluate(
                 f"{n_axis}-way data axis; cannot build one shardable batch"
             )
         batch_size = min(batch_size, max_bs)
+    # caller-supplied sizes must land on a data-axis multiple on BOTH
+    # paths (indexed and sampled), or shard_batch raises mid-eval
+    batch_size = batch_size // n_axis * n_axis
+    if batch_size == 0:
+        raise ValueError(
+            f"batch_size {requested} rounds down to 0 on the "
+            f"{n_axis}-way data axis; pass batch_size >= {n_axis}"
+        )
+    if capable:
         full_batches = len(dataset) // batch_size
     if max_batches is None:
         if not hasattr(dataset, "__len__"):

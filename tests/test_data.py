@@ -208,7 +208,7 @@ def test_byte_text_dataset(tmp_path):
 
     ds = ByteTextDataset(str(p), seqlen=16)
     assert ds.vocab == 256
-    assert len(ds) == (len(corpus) - 1) // 16
+    assert len(ds) == len(corpus) // 16
     rng = np.random.default_rng(0)
     toks = ds.batch(rng, 8)
     assert toks.shape == (8, 16) and toks.dtype == np.int32
@@ -226,3 +226,22 @@ def test_byte_text_dataset(tmp_path):
         small = tmp_path / "small.txt"
         small.write_bytes(b"xy")
         ByteTextDataset(str(small), seqlen=16)
+
+
+def test_byte_text_dataset_boundary(tmp_path):
+    """A file of exactly seqlen bytes is one valid window, and the final
+    byte of any corpus is reachable (window starts have an inclusive
+    upper bound of len - seqlen)."""
+    from fluxdistributed_tpu.data import ByteTextDataset
+
+    exact = tmp_path / "exact.txt"
+    exact.write_bytes(b"0123456789abcdef")  # exactly 16 bytes
+    ds = ByteTextDataset(str(exact), seqlen=16)
+    toks = ds.batch(np.random.default_rng(0), 4)
+    assert (toks == np.frombuffer(b"0123456789abcdef", np.uint8)).all()
+
+    tail = tmp_path / "tail.txt"
+    tail.write_bytes(b"aaaaaaaaZ")  # 9 bytes, seqlen 8: starts in {0, 1}
+    ds = ByteTextDataset(str(tail), seqlen=8)
+    toks = ds.batch(np.random.default_rng(0), 256)
+    assert (toks[:, -1] == ord("Z")).any(), "final corpus byte never sampled"

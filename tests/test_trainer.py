@@ -224,6 +224,13 @@ def test_evaluate_whole_dataset(mesh):
     # truncated coverage is honestly flagged
     out_trunc = evaluate(task, ds, batch_size=32, max_batches=1, topk=(1,))
     assert out_trunc["samples"] == 32 and out_trunc["exact"] is False
+    # a size indivisible by the data axis rounds DOWN to a shardable one
+    # instead of failing inside shard_batch mid-eval
+    n_axis = task.mesh.shape["data"]
+    out_odd = evaluate(task, ds, batch_size=n_axis * 4 + 1, topk=(1,))
+    assert out_odd["samples"] % n_axis == 0 and out_odd["samples"] > 0
+    with pytest.raises(ValueError, match="rounds down"):
+        evaluate(task, ds, batch_size=n_axis - 1, topk=(1,))
     # trained on a learnable task -> much better than the 25% chance floor
     assert out["top1"] > 0.8, out
 
@@ -238,3 +245,7 @@ def test_evaluate_whole_dataset(mesh):
     out = evaluate(lm_task, tds, batch_size=16, max_batches=2, topk=())
     assert out["samples"] == 32 and out["exact"] is False
     assert np.isfinite(out["loss"])
+    # the SAMPLED path (no `indices` support) must round an indivisible
+    # batch_size down too, not crash in shard_batch mid-eval
+    out_odd = evaluate(lm_task, tds, batch_size=17, max_batches=1, topk=())
+    assert out_odd["samples"] == 16

@@ -247,6 +247,19 @@ def test_generate_rejects_bad_config(model_and_params):
         generate(model, params, np.zeros((1, 1), np.int32), 4)
 
 
+def test_decode_rejects_custom_attn_fn():
+    """The KV-cache path always uses the dense attention core; a custom
+    attn_fn (e.g. ring attention) must fail loudly, not be dropped."""
+    from fluxdistributed_tpu.models.transformer_lm import CausalSelfAttention
+
+    attn = CausalSelfAttention(
+        num_heads=2, dtype=jnp.float32, decode=True,
+        attn_fn=lambda q, k, v: v,
+    )
+    with pytest.raises(ValueError, match="attn_fn"):
+        attn.init(jax.random.PRNGKey(0), jnp.zeros((1, 4, 8), jnp.float32))
+
+
 def test_lm_through_trainer():
     """The full user path for LM training: SyntheticTextDataset →
     PrefetchLoader (token protocol) → prepare_training(loss_fn=...) →
