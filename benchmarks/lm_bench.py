@@ -60,6 +60,9 @@ def main():
     step = make_train_step(models.lm_loss_fn(model), opt, mesh, donate=True)
     b = sharding.shard_batch({"tokens": toks}, mesh)
 
+    # exact FLOPs from XLA cost analysis (before timing — donation kills
+    # the state buffers) feeds the hardware-normalized MFU figure
+    fl = bench.step_flops(step, state, b)
     dt, iters = bench.time_compiled_step(step, state, b, target_seconds=args.seconds)
     tok_s_chip = batch * args.seqlen / dt / nchips
     # decoder train step ~= 6*N FLOPs/token (fwd 2N + bwd 4N), +1 fwd if remat
@@ -70,6 +73,7 @@ def main():
                   f"{', remat' if args.remat else ''})",
         "value": round(tok_s_chip, 1),
         "unit": "tokens/sec/chip",
+        "mfu_pct": bench.mfu_pct(fl, dt, nchips),
         "params_millions": round(nparams / 1e6, 1),
         "approx_model_tflops_per_chip": round(tok_s_chip * flops_per_tok / 1e12, 2),
         "step_ms": round(dt * 1e3, 2),

@@ -26,6 +26,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # rows most likely to move the headline number have already printed.
 CONFIGS = [
     {"name": "baseline-bf16", "env": {}},
+    # fused multi-step: K optimizer steps per dispatch.  The runtime sits
+    # behind a network tunnel (axon) — if throughput jumps with fusion,
+    # the gap is host dispatch latency, not on-chip time
+    {"name": "fuse-8", "env": {"SWEEP_FUSE": "8"}},
+    {"name": "fuse-32", "env": {"SWEEP_FUSE": "32"}},
     {"name": "latency-hiding-sched", "env": {
         "SWEEP_XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=true"}},
     {"name": "batch-512", "env": {"SWEEP_BATCH": "512"}},
@@ -60,6 +65,7 @@ def measure_one() -> dict:
     import bench
 
     batch = int(os.environ.get("SWEEP_BATCH", "256"))
+    fuse = int(os.environ.get("SWEEP_FUSE", "1"))
     step, state, b = bench.build_step(
         batch,
         size=int(os.environ.get("SWEEP_SIZE", "224")),
@@ -68,14 +74,17 @@ def measure_one() -> dict:
         norm_dtype=jnp.float32 if _env_flag("SWEEP_BN_F32") else None,
         input_f32=_env_flag("SWEEP_INPUT_F32"),
         remat=_env_flag("SWEEP_REMAT"),
+        fuse=fuse,
     )
     dt, _ = bench.time_compiled_step(
         step, state, b, target_seconds=float(os.environ.get("SWEEP_SECONDS", "2.0"))
     )
+    # one fused call covers `fuse` optimizer steps on the same batch
     return {
-        "img_per_sec_per_chip": round(batch / dt / jax.device_count(), 1),
-        "step_ms": round(dt * 1e3, 2),
+        "img_per_sec_per_chip": round(batch * fuse / dt / jax.device_count(), 1),
+        "step_ms": round(dt * 1e3 / fuse, 2),
         "batch": batch,
+        "fuse": fuse,
         "platform": jax.devices()[0].platform,
     }
 

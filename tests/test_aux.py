@@ -52,3 +52,18 @@ def test_wandb_logger_uses_wandb_module(monkeypatch):
     lg.log({"loss": 1.5}, step=3)
     assert calls["init"] == [{"project": "test-proj"}]
     assert calls["log"] == [({"loss": 1.5}, 3)]
+
+
+def test_docs_site_config_complete():
+    """mkdocs.yml (the Documenter-site analog, ref docs/make.jl) stays in
+    sync with docs/: every nav entry exists, every docs page is in nav."""
+    import os
+
+    yaml = pytest.importorskip("yaml")
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    with open(os.path.join(root, "mkdocs.yml")) as f:
+        cfg = yaml.safe_load(f)
+    nav = {v for item in cfg["nav"] for v in item.values()}
+    pages = {f for f in os.listdir(os.path.join(root, "docs")) if f.endswith(".md")}
+    assert nav == pages, (nav - pages, pages - nav)

@@ -30,6 +30,34 @@ def test_build_step_runs_one_step(bench_mod):
     assert int(state2.step) == 1
 
 
+def test_fused_steps_advance_state(bench_mod):
+    """fuse=k runs k optimizer steps per call (one dispatch), same
+    (state, metrics) signature as the plain step."""
+    step, state, b = bench_mod.build_step(batch=8, size=32, fuse=4)
+    state2, m = step(state, b)
+    assert int(state2.step) == 4
+    assert float(m["loss"]) > 0
+    state3, _ = step(state2, b)
+    assert int(state3.step) == 8
+
+
+def test_step_flops_and_mfu(bench_mod):
+    """Cost analysis counts a sane FLOP total WITHOUT a second compile;
+    mfu_pct is None on CPU (unknown peak) and arithmetic on a known one."""
+    step, state, b = bench_mod.build_step(batch=8, size=32, donate=False)
+    fl = bench_mod.step_flops(step, state, b)
+    # ResNet-50 fwd+bwd at 32x32 is ~0.25 GFLOP/img -> total well over 1e8
+    assert fl > 1e8, fl
+    assert bench_mod.mfu_pct(fl, dt=0.01, nchips=8) is None  # cpu device_kind
+    # direct arithmetic check against a fake peak table entry
+    bench_mod._PEAK_BF16_TFLOPS["cpu"] = 1.0  # device_kind == "cpu" on host
+    try:
+        got = bench_mod.mfu_pct(1e10, dt=0.1, nchips=1)
+        assert got == 10.0, got  # 1e10/0.1 = 1e11 FLOP/s = 10% of 1 TFLOP/s
+    finally:
+        bench_mod._PEAK_BF16_TFLOPS.pop("cpu")
+
+
 def test_build_step_variant_knobs(bench_mod):
     import jax.numpy as jnp
 

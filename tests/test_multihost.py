@@ -74,6 +74,26 @@ def test_two_process_training_and_collectives():
 
 
 @pytest.mark.slow
+def test_two_process_pipeline_and_moe():
+    """2 processes x 4 CPU devices: the GPipe ppermute ring and the MoE
+    dispatch/return all_to_alls cross a real process boundary (the DCN
+    stand-in), forward AND backward, with shard-level parity against
+    dense references computed locally in each worker."""
+    port = _free_port()
+    outs = _run_procs(
+        [
+            [sys.executable, os.path.join("tests", "_mh_ppep_worker.py"),
+             str(i), "2", str(port)]
+            for i in range(2)
+        ]
+    )
+    for i, out in enumerate(outs):
+        assert f"worker {i}: OK" in out, out[-3000:]
+        for part in ("PP forward", "PP backward", "EP forward", "EP backward"):
+            assert f"{part} parity OK" in out, (part, out[-3000:])
+
+
+@pytest.mark.slow
 def test_driver_cli_fake_cluster():
     """bin/driver.py end-to-end in manual bring-up mode — the analog of
     the reference's bin/driver.jl session, minus the channel plumbing."""
