@@ -87,6 +87,7 @@ def build_step(
     input_f32: bool = False,
     remat: bool = False,
     fuse: int = 1,
+    s2d: bool = False,
 ):
     """Build the headline measurement target: ResNet-50, DP mesh over all
     chips, compiled train step, device-resident batch.
@@ -106,9 +107,17 @@ def build_step(
     from fluxdistributed_tpu.parallel.dp import flax_loss_fn
 
     mesh = fd.data_mesh()
-    model = resnet50(num_classes=1000, norm_dtype=norm_dtype, remat=remat)
+    model = resnet50(
+        num_classes=1000, norm_dtype=norm_dtype, remat=remat,
+        space_to_depth=s2d,
+    )
     rng = np.random.default_rng(0)
     x = rng.normal(0, 1, (batch, size, size, 3)).astype(np.float32)
+    if s2d:
+        # host-side re-layout, like a real input pipeline would feed it
+        from fluxdistributed_tpu.models.resnet import space_to_depth
+
+        x = np.ascontiguousarray(space_to_depth(x))
     y = rng.integers(0, 1000, batch)
 
     variables = model.init(jax.random.PRNGKey(0), x[:1], train=True)

@@ -1,8 +1,15 @@
 #!/bin/sh
 # Staged hardware-benchmark session: run the full perf chain the moment
-# the tunneled chip answers, ONE TPU client at a time, every step under
-# `timeout` with compile headroom (never kill a TPU client by hand —
-# the axon device grant wedges server-side).
+# the tunneled chip answers, ONE TPU client at a time.
+#
+# Timeout policy: every stage runs under `timeout` with LARGE headroom
+# (>= 3x the worst observed compile+run). Killing a live TPU client can
+# wedge the axon device grant server-side — but an unbounded hang in
+# backend init (observed: 25-35 min before an explicit UNAVAILABLE)
+# would stall the whole session forever. The bounds below only fire in
+# that hung-init mode, where the grant was never acquired; they are
+# deliberately far above any healthy stage duration. Do NOT kill stages
+# by hand.
 #
 #   sh benchmarks/hw_session.sh [outdir]          # default benchmarks/hw
 #
@@ -16,7 +23,7 @@ mkdir -p "$OUT"
 stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
 
 echo "[$(stamp)] 1/6 headline bench" | tee -a "$OUT/session.log"
-timeout 1200 python bench.py >> "$OUT/bench.jsonl" 2>> "$OUT/session.log"
+timeout 3000 python bench.py >> "$OUT/bench.jsonl" 2>> "$OUT/session.log"
 
 echo "[$(stamp)] 2/6 step sweep (leverage-ordered; fuse rows isolate tunnel dispatch)" | tee -a "$OUT/session.log"
 # no outer timeout: every sweep child self-bounds at 1800s, and killing
@@ -24,18 +31,18 @@ echo "[$(stamp)] 2/6 step sweep (leverage-ordered; fuse rows isolate tunnel disp
 python benchmarks/step_sweep.py >> "$OUT/sweep.jsonl" 2>> "$OUT/session.log"
 
 echo "[$(stamp)] 3/6 trace analysis" | tee -a "$OUT/session.log"
-timeout 1800 python benchmarks/trace_analysis.py >> "$OUT/trace.txt" 2>> "$OUT/session.log"
+timeout 3600 python benchmarks/trace_analysis.py >> "$OUT/trace.txt" 2>> "$OUT/session.log"
 
 echo "[$(stamp)] 4/6 step segments + cost analysis" | tee -a "$OUT/session.log"
-timeout 1800 python benchmarks/train_step_segments.py >> "$OUT/segments.txt" 2>> "$OUT/session.log"
+timeout 3600 python benchmarks/train_step_segments.py >> "$OUT/segments.txt" 2>> "$OUT/session.log"
 
 echo "[$(stamp)] 5/6 LM benches" | tee -a "$OUT/session.log"
-timeout 1800 python benchmarks/lm_bench.py --model lm_small --seqlen 1024 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
-timeout 1800 python benchmarks/lm_bench.py --model lm_small --seqlen 2048 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
-timeout 1800 python benchmarks/lm_bench.py --model lm_medium --seqlen 1024 --batch 8 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
-timeout 1800 python benchmarks/lm_bench.py --model lm_medium --seqlen 1024 --batch 8 --remat >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
+timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 1024 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
+timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 2048 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
+timeout 2700 python benchmarks/lm_bench.py --model lm_medium --seqlen 1024 --batch 8 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
+timeout 2700 python benchmarks/lm_bench.py --model lm_medium --seqlen 1024 --batch 8 --remat >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
 
 echo "[$(stamp)] 6/6 end-to-end ingest" | tee -a "$OUT/session.log"
-timeout 2400 python benchmarks/ingest_e2e.py --steps 20 >> "$OUT/ingest.jsonl" 2>> "$OUT/session.log"
+timeout 3600 python benchmarks/ingest_e2e.py --steps 20 >> "$OUT/ingest.jsonl" 2>> "$OUT/session.log"
 
 echo "[$(stamp)] session complete" | tee -a "$OUT/session.log"

@@ -31,6 +31,10 @@ CONFIGS = [
     # the gap is host dispatch latency, not on-chip time
     {"name": "fuse-8", "env": {"SWEEP_FUSE": "8"}},
     {"name": "fuse-32", "env": {"SWEEP_FUSE": "32"}},
+    # MXU-shaped stem: space_to_depth input + equivalent 4x4/1 conv
+    # replaces the 7x7/2-on-3-channels stem pathology (exact re-layout,
+    # tests/test_resnet_s2d.py)
+    {"name": "s2d-stem", "env": {"SWEEP_S2D": "1"}},
     {"name": "latency-hiding-sched", "env": {
         "SWEEP_XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=true"}},
     {"name": "batch-512", "env": {"SWEEP_BATCH": "512"}},
@@ -75,6 +79,7 @@ def measure_one() -> dict:
         input_f32=_env_flag("SWEEP_INPUT_F32"),
         remat=_env_flag("SWEEP_REMAT"),
         fuse=fuse,
+        s2d=_env_flag("SWEEP_S2D"),
     )
     dt, _ = bench.time_compiled_step(
         step, state, b, target_seconds=float(os.environ.get("SWEEP_SECONDS", "2.0"))

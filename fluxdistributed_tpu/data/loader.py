@@ -53,6 +53,17 @@ def batch_to_dict(out, nclasses=None, one_hot: bool = True) -> dict:
     return {"tokens": np.asarray(out)}
 
 
+def apply_transform(transform, out):
+    """Dispatch a host-side batch hook per the dataset protocol: tuple
+    draws unpack to ``transform(imgs, labels)``, dict/bare-array draws
+    pass as one argument.  The ONE place the dispatch rule lives —
+    PrefetchLoader, prepare_training, and evaluate all route through it
+    so training/eval always see the same layout."""
+    if transform is None:
+        return out
+    return transform(*out) if isinstance(out, tuple) else transform(out)
+
+
 def model_input(out) -> np.ndarray:
     """The array a model's ``init`` should trace from a ``batch()`` draw:
     ``image`` / ``tokens`` by convention, else the dict's first entry."""
@@ -147,9 +158,7 @@ class PrefetchLoader:
         # sampling, src/sync.jl:135).
         rng = np.random.default_rng((self.seed, jax.process_index(), i))
         out = self.dataset.batch(rng, self._local_batch)
-        if self.transform is not None:
-            out = self.transform(*out) if isinstance(out, tuple) else self.transform(out)
-        return out
+        return apply_transform(self.transform, out)
 
     def _put(self, out):
         from ..parallel.multihost import global_batch_put

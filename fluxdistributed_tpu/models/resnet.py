@@ -29,9 +29,54 @@ import jax.numpy as jnp
 
 from .common import maybe_remat
 
-__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152"]
+__all__ = [
+    "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+    "space_to_depth", "s2d_stem_kernel",
+]
 
 ModuleDef = Any
+
+
+def space_to_depth(x, block: int = 2):
+    """(B, H, W, C) → (B, H/b, W/b, b²·C): each b×b pixel block becomes
+    channels, ordered (row-offset, col-offset, channel).
+
+    The MLPerf-style stem transform: the 7×7/2 stem conv reads 3-channel
+    input — a contraction dim of 3 that strands most of the MXU's 128
+    lanes and whose stride-2 taps defeat clean tiling.  On the s2d
+    layout the equivalent conv (see :func:`s2d_stem_kernel`) is 4×4/1
+    over 12 channels — same arithmetic, MXU-shaped.  Works on numpy or
+    jax arrays; do it host-side in the input pipeline when feeding a
+    ``space_to_depth=True`` model (in-graph fallback otherwise).
+    """
+    b, h, w, c = x.shape
+    assert h % block == 0 and w % block == 0, (h, w, block)
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h // block, w // block, block * block * c)
+
+
+def s2d_stem_kernel(w):
+    """Exact re-layout of a 7×7-stride-2 stem kernel (7, 7, C, O) into
+    the equivalent 4×4-stride-1 kernel (4, 4, 4·C, O) over
+    :func:`space_to_depth`-transformed input.
+
+    Derivation: pad the 7-tap kernel to 8 with one leading zero (the
+    stride-2 window ``x[2p + k - 3]``, k∈[0,7) equals a 4-tap stride-1
+    window over pixel pairs with taps at p-2…p+1); each 2×2 sub-block of
+    the 8×8 kernel contracts against the matching s2d channel group.
+    With this kernel and padding (2, 1), ``conv(s2d(x))`` reproduces the
+    original stem exactly — proven in tests/test_resnet_s2d.py.
+    """
+    import numpy as np
+
+    w = np.asarray(w)
+    kh, kw, c, o = w.shape
+    assert (kh, kw) == (7, 7), "s2d transform is for the 7x7 stem"
+    w8 = np.zeros((8, 8, c, o), w.dtype)
+    w8[1:, 1:] = w
+    w8 = w8.reshape(4, 2, 4, 2, c, o).transpose(0, 2, 1, 3, 4, 5)
+    return w8.reshape(4, 4, 4 * c, o)
 
 
 _PAD3 = ((1, 1), (1, 1))  # torch-convention padding for 3x3 convs
@@ -108,6 +153,12 @@ class ResNet(nn.Module):
     # forward of FLOPs (jax.checkpoint): the HBM lever for bigger
     # per-chip batches
     remat: bool = False
+    # MXU-shaped stem: accept space_to_depth(x) input (B, H/2, W/2, 12)
+    # and run the equivalent 4x4/1 conv instead of 7x7/2 on 3 channels.
+    # Raw (B, H, W, 3) input is transformed in-graph as a fallback; feed
+    # pre-transformed batches for peak rate.  Stem kernel shape changes
+    # to (4, 4, 12, width) — import 7x7 weights via s2d_stem_kernel.
+    space_to_depth: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -133,7 +184,20 @@ class ResNet(nn.Module):
         # (models/torch_import.py), the analog of the reference's
         # pretrained-weight path (src/preprocess.jl:9-24).
         x = jnp.asarray(x, self.dtype)
-        x = conv(self.width, (7, 7), (2, 2), padding=((3, 3), (3, 3)), name="stem_conv")(x)
+        if self.space_to_depth:
+            if x.shape[-1] == 3:
+                x = space_to_depth(x)  # in-graph fallback; prefer host-side
+            # padding (2,1): the 8-padded stride-2 window spans s2d
+            # positions p-2..p+1 (see s2d_stem_kernel)
+            x = conv(
+                self.width, (4, 4), (1, 1), padding=((2, 1), (2, 1)),
+                name="stem_conv",
+            )(x)
+        else:
+            x = conv(
+                self.width, (7, 7), (2, 2), padding=((3, 3), (3, 3)),
+                name="stem_conv",
+            )(x)
         x = norm(name="stem_bn")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

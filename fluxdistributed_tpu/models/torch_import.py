@@ -62,10 +62,15 @@ def _linear(sd: Mapping, name: str) -> dict:
 
 
 def import_torch_resnet(
-    state_dict: Mapping[str, Any], depth: int = 50
+    state_dict: Mapping[str, Any], depth: int = 50, space_to_depth: bool = False
 ) -> tuple[dict, dict]:
     """Convert a torchvision-layout ResNet ``state_dict`` to
     ``(params, model_state)`` for ``models.resnet{depth}``.
+
+    ``space_to_depth=True`` re-lays the 7x7 stem kernel into the exact
+    4x4 equivalent (``resnet.s2d_stem_kernel``) for a model built with
+    ``space_to_depth=True`` — pretrained weights keep working on the
+    MXU-shaped stem.
 
     Returns trees ready for
     ``model.apply({"params": params, **model_state}, x, train=False)``.
@@ -80,7 +85,12 @@ def import_torch_resnet(
     params: dict = {}
     stats: dict = {}
 
-    params["stem_conv"] = {"kernel": _conv(state_dict, "conv1")}
+    stem = _conv(state_dict, "conv1")
+    if space_to_depth:
+        from .resnet import s2d_stem_kernel
+
+        stem = s2d_stem_kernel(stem)
+    params["stem_conv"] = {"kernel": stem}
     params["stem_bn"], stats["stem_bn"] = _bn(state_dict, "bn1")
 
     k = 0  # flat block index, matching the compact-module naming order

@@ -62,6 +62,9 @@ class TrainTask:
     model: Any
     val_batch: Optional[dict] = None
     num_missed: int = 0
+    # host-side batch hook (the loader's ``transform``), kept so
+    # ``evaluate`` feeds the model the same layout training did
+    transform: Optional[Callable] = None
 
 
 def prepare_training(
@@ -84,6 +87,7 @@ def prepare_training(
     donate: bool = False,
     topk: Sequence[int] = (1, 5, 10),
     accum_steps: int = 1,
+    transform: Optional[Callable] = None,
 ) -> TrainTask:
     """Initialize params, compile the SPMD step, build prefetch loaders.
 
@@ -110,7 +114,15 @@ def prepare_training(
     framework loss signature — e.g. ``models.lm_loss_fn(model)`` trains
     the transformer LM on a token dataset through this same path (pass
     ``topk=()``: top-k image metrics don't apply to LM batches).
+
+    ``transform`` is the loader's host-side batch hook (per the dataset
+    protocol: ``transform(imgs, labels)`` for tuple datasets, one
+    argument otherwise) — e.g. ``models.space_to_depth`` re-layout for a
+    ``space_to_depth=True`` ResNet.  It is applied consistently to the
+    init sample, the train loader, the val slice, and ``evaluate``.
     """
+    from ..data.loader import apply_transform
+
     mesh = mesh or mesh_lib.data_mesh()
     if input_shape is not None:
         dummy = np.zeros((1, *input_shape), np.float32)
@@ -119,7 +131,9 @@ def prepare_training(
         # dtype (f32 images, int32 tokens, ...)
         from ..data.loader import model_input
 
-        dummy = model_input(dataset.batch(np.random.default_rng(0), 1))
+        dummy = model_input(
+            apply_transform(transform, dataset.batch(np.random.default_rng(0), 1))
+        )
 
     p_rng, d_rng = jax.random.split(jax.random.PRNGKey(seed))
     # 'dropout' stream present at init so stochastic models (ViT dropout,
@@ -220,6 +234,7 @@ def prepare_training(
         epochs=epochs,
         buffersize=buffersize,
         seed=seed,
+        transform=transform,
     )
 
     val_batch = None
@@ -233,7 +248,9 @@ def prepare_training(
         if was_augment:
             val_dataset.augment = False
         try:
-            vdraw = val_dataset.batch(np.random.default_rng(seed + 1), nval)
+            vdraw = apply_transform(
+                transform, val_dataset.batch(np.random.default_rng(seed + 1), nval)
+            )
         finally:
             if was_augment:
                 val_dataset.augment = True
@@ -252,6 +269,7 @@ def prepare_training(
         mesh=mesh,
         model=model,
         val_batch=val_batch,
+        transform=transform,
     )
 
 
@@ -327,7 +345,7 @@ def evaluate(
     """
     import inspect
 
-    from ..data.loader import batch_to_dict
+    from ..data.loader import apply_transform, batch_to_dict
 
     capable = (
         hasattr(dataset, "__len__")
@@ -380,6 +398,7 @@ def evaluate(
                 draw = dataset.batch(rng, batch_size, indices=idx)
             else:
                 draw = dataset.batch(rng, batch_size)
+            draw = apply_transform(task.transform, draw)
             batch = sharding_lib.shard_batch(
                 batch_to_dict(draw, getattr(dataset, "nclasses", None)), task.mesh
             )

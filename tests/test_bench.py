@@ -73,6 +73,11 @@ def test_build_step_variant_knobs(bench_mod):
     _, m = step(state, b)
     assert float(m["loss"]) > 0
 
+    step, state, b = bench_mod.build_step(batch=8, size=32, donate=False, s2d=True)
+    assert b["image"].shape == (8, 16, 16, 12)  # host-side re-layout fed
+    _, m = step(state, b)
+    assert float(m["loss"]) > 0
+
 
 def test_main_emits_error_json_and_rc0_on_failure(bench_mod, monkeypatch, capsys):
     """main() must print the JSON line and return normally no matter how

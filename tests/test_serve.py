@@ -77,3 +77,42 @@ def test_unknown_path_404(server):
     with pytest.raises(urllib.error.HTTPError) as ei:
         urllib.request.urlopen(f"{server}/nope")
     assert ei.value.code == 404
+
+
+def test_predict_concurrent_load(server):
+    """ThreadingHTTPServer + jitted forward under concurrent clients: all
+    requests succeed, identical frames classify identically (the compiled
+    call is thread-safe), and distinct frames interleaved across threads
+    do not cross-contaminate responses."""
+    import concurrent.futures
+
+    frames = {}
+    rng = np.random.default_rng(7)
+    from PIL import Image
+
+    for key in range(3):
+        arr = rng.integers(0, 255, (240, 320, 3), dtype=np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG")
+        frames[key] = buf.getvalue()
+
+    def post(key):
+        req = urllib.request.Request(
+            f"{server}/predict", data=frames[key], method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            data = json.loads(r.read())
+        return key, [(p["label"], round(p["prob"], 5)) for p in data["predictions"]]
+
+    jobs = [k for k in frames for _ in range(8)]  # 24 requests, 3 frames
+    with concurrent.futures.ThreadPoolExecutor(max_workers=6) as ex:
+        results = list(ex.map(post, jobs))
+
+    by_frame = {}
+    for key, preds in results:
+        by_frame.setdefault(key, []).append(preds)
+    assert sum(len(v) for v in by_frame.values()) == len(jobs)
+    for key, preds_list in by_frame.items():
+        assert all(p == preds_list[0] for p in preds_list), (
+            f"frame {key}: concurrent responses diverged"
+        )

@@ -173,3 +173,25 @@ def test_convnext_import_tree_matches_init():
     got = jax.tree.map(np.shape, params)
     want = jax.tree.map(np.shape, ref["params"])
     assert got == want
+
+
+def test_logit_parity_s2d_stem():
+    """Torch weights imported with space_to_depth=True match the torch
+    reference through the MXU-shaped stem — pretrained weights survive
+    the stem re-layout exactly."""
+    from fluxdistributed_tpu.models.resnet import space_to_depth
+
+    torch.manual_seed(0)
+    tm = torch_resnet(18, num_classes=1000).eval()
+    params, mstate = import_torch_resnet(
+        tm.state_dict(), depth=18, space_to_depth=True
+    )
+    model = resnet18(num_classes=1000, dtype=jnp.float32, space_to_depth=True)
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    out = np.asarray(
+        model.apply({"params": params, **mstate}, space_to_depth(x), train=False)
+    )
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
