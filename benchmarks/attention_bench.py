@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Attention-core microbenchmark: Pallas flash vs XLA blockwise vs dense.
+
+The framework's hand-written hot-op (ops/pallas_attention.py) exists to
+beat the dense core's HBM behavior at long T; this measures whether it
+does on real hardware — per-core ms and achieved TFLOP/s for forward and
+forward+backward at growing sequence lengths, causal, bf16.
+
+    python benchmarks/attention_bench.py                    # TPU
+    python benchmarks/attention_bench.py --platform cpu \
+        --seqlens 128 --batch 1 --heads 2 --dim 32          # smoke
+
+Attention FLOPs ≈ 4·B·H·T²·D forward (q·kᵀ + p·v), halved when causal;
+backward ≈ 2.5× forward.  Run under `timeout`, never kill a TPU client.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, _HERE)
+
+# the shared pure-function timing protocol (3-step post-compile warmup),
+# so attention rows are measured like every other hw_session row
+from train_step_segments import timeit  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--seqlens", default="1024,2048,4096")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--block", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from fluxdistributed_tpu.ops.attention import (
+        blockwise_attention, dot_product_attention,
+    )
+    from fluxdistributed_tpu.ops.pallas_attention import flash_attention
+
+    B, H, D = args.batch, args.heads, args.dim
+    blk = args.block
+    cores = [
+        ("dense", jax.jit(lambda q, k, v: dot_product_attention(q, k, v, causal=True))),
+        ("blockwise-xla", jax.jit(
+            lambda q, k, v: blockwise_attention(q, k, v, block_size=blk, causal=True))),
+        ("pallas-flash", jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, True, blk, blk))),
+    ]
+    grads = {
+        name: jax.jit(jax.grad(lambda q, k, v, f=fn: jnp.sum(f(q, k, v).astype(jnp.float32)),
+                               argnums=(0, 1, 2)))
+        for name, fn in cores
+    }
+
+    rows = []
+    for t in [int(s) for s in args.seqlens.split(",")]:
+        rng = np.random.default_rng(0)
+        q, k, v = (
+            jnp.asarray(rng.normal(0, 1, (B, t, H, D)), jnp.bfloat16)
+            for _ in range(3)
+        )
+        fwd_flops = 4 * B * H * t * t * D / 2  # causal halves the score work
+        for name, fn in cores:
+            if name == "dense" and t > 8192:
+                continue  # T^2 scores in HBM; keep the sweep bounded
+            dt = timeit(fn, q, k, v, n=args.iters)
+            dtg = timeit(grads[name], q, k, v, n=max(5, args.iters // 2))
+            rows.append({
+                "core": name, "T": t,
+                "fwd_ms": round(dt * 1e3, 3),
+                "fwd_tflops": round(fwd_flops / dt / 1e12, 2),
+                "fwdbwd_ms": round(dtg * 1e3, 3),
+            })
+            print(json.dumps(rows[-1]), flush=True)
+
+    print(json.dumps({
+        "metric": "attention-core microbench (causal, bf16)",
+        "config": {"B": B, "H": H, "D": D, "block": blk},
+        "platform": jax.devices()[0].platform,
+        "rows": rows,
+    }))
+
+
+if __name__ == "__main__":
+    main()

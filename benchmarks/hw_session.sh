@@ -22,27 +22,31 @@ OUT="${1:-benchmarks/hw}"
 mkdir -p "$OUT"
 stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
 
-echo "[$(stamp)] 1/6 headline bench" | tee -a "$OUT/session.log"
+echo "[$(stamp)] 1/7 headline bench" | tee -a "$OUT/session.log"
 timeout 3000 python bench.py >> "$OUT/bench.jsonl" 2>> "$OUT/session.log"
 
-echo "[$(stamp)] 2/6 step sweep (leverage-ordered; fuse rows isolate tunnel dispatch)" | tee -a "$OUT/session.log"
+echo "[$(stamp)] 2/7 step sweep (leverage-ordered; fuse rows isolate tunnel dispatch)" | tee -a "$OUT/session.log"
 # no outer timeout: every sweep child self-bounds at 1800s, and killing
 # the parent would orphan a TPU child still holding the device grant
 python benchmarks/step_sweep.py >> "$OUT/sweep.jsonl" 2>> "$OUT/session.log"
 
-echo "[$(stamp)] 3/6 trace analysis" | tee -a "$OUT/session.log"
+echo "[$(stamp)] 3/7 trace analysis" | tee -a "$OUT/session.log"
 timeout 3600 python benchmarks/trace_analysis.py >> "$OUT/trace.txt" 2>> "$OUT/session.log"
 
-echo "[$(stamp)] 4/6 step segments + cost analysis" | tee -a "$OUT/session.log"
+echo "[$(stamp)] 4/7 step segments + cost analysis" | tee -a "$OUT/session.log"
 timeout 3600 python benchmarks/train_step_segments.py >> "$OUT/segments.txt" 2>> "$OUT/session.log"
 
-echo "[$(stamp)] 5/6 LM benches" | tee -a "$OUT/session.log"
+echo "[$(stamp)] 5/7 LM benches" | tee -a "$OUT/session.log"
 timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 1024 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
 timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 2048 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
 timeout 2700 python benchmarks/lm_bench.py --model lm_medium --seqlen 1024 --batch 8 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
 timeout 2700 python benchmarks/lm_bench.py --model lm_medium --seqlen 1024 --batch 8 --remat >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
 
-echo "[$(stamp)] 6/6 end-to-end ingest" | tee -a "$OUT/session.log"
+echo "[$(stamp)] 6/7 end-to-end ingest" | tee -a "$OUT/session.log"
 timeout 3600 python benchmarks/ingest_e2e.py --steps 20 >> "$OUT/ingest.jsonl" 2>> "$OUT/session.log"
 
-echo "[$(stamp)] session complete" | tee -a "$OUT/session.log"
+
+echo "[$(stamp)] 7/7 attention-core microbench" | tee -a "$OUT/session.log"
+timeout 2700 python benchmarks/attention_bench.py >> "$OUT/attention.jsonl" 2>> "$OUT/session.log"
+
+echo "[$(stamp)] session complete (incl. attention)" | tee -a "$OUT/session.log"
