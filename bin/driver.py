@@ -71,6 +71,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="after training, aggregate loss/top-k over the FULL "
                         "--val-dataset with train.evaluate")
     p.add_argument("--spmd", default="jit", choices=["jit", "shard_map", "fsdp", "tp", "fsdp_tp"])
+    p.add_argument("--steps-per-call", type=int, default=1,
+                   help="optimizer steps per dispatch (device loop; spmd=jit). "
+                        "Amortizes host dispatch when the runtime is tunneled")
     p.add_argument("--tp", type=int, default=None,
                    help="model-axis size for --spmd tp / fsdp_tp (mesh "
                         "becomes {data: N/tp, model: tp}; required for "
@@ -193,6 +196,7 @@ def main(argv=None) -> int:
         cycles=args.cycles,
         val_dataset=val_dataset,
         spmd=args.spmd,
+        steps_per_call=args.steps_per_call,
         **lm_extra,
     )
 

@@ -116,12 +116,15 @@ class PrefetchLoader:
         one_hot: bool = True,
         num_threads: int = 2,
         transform: Optional[Callable] = None,
+        chunk: int = 1,
     ):
         n = mesh.shape[axis]
         if batch_size % n:
             raise ValueError(
                 f"global batch {batch_size} not divisible by mesh axis '{axis}' size {n}"
             )
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
         self.dataset = dataset
         self.mesh = mesh
         self.batch_size = batch_size
@@ -130,7 +133,15 @@ class PrefetchLoader:
         self.transform = transform
         self.seed = seed
         self.num_threads = max(1, num_threads)
+        # chunk > 1: the device-loop layout for steps_per_call training —
+        # each yielded item stacks `chunk` per-step batches on a NEW
+        # leading dim, sharded [K(replicated), batch(data axis), ...].
+        # Sub-batch j of item c is bit-identical to step c*chunk+j of an
+        # unchunked run (same rng derivation), so chunking never changes
+        # what the model sees, only how many dispatches feed it.
+        self.chunk = chunk
         self.sharding = NamedSharding(mesh, P(axis))
+        self._chunk_sharding = NamedSharding(mesh, P(None, axis))
         # Multi-host: each process assembles only its rows of the global
         # batch (the analog of each reference worker sampling its own
         # minibatch, src/sync.jl:135); jax.make_array_from_process_local_data
@@ -145,7 +156,14 @@ class PrefetchLoader:
                     "stream, e.g. a generated token dataset) — pass cycles= "
                     "explicitly instead of deriving it from epochs"
                 )
+            # derived count: round down to a chunk multiple (a caller
+            # never chose this exact number, so don't error on it)
             cycles = max(1, (len(dataset) * epochs) // batch_size)
+            cycles = max(self.chunk, cycles // self.chunk * self.chunk)
+        if cycles % self.chunk:
+            raise ValueError(
+                f"cycles ({cycles}) must be a multiple of chunk ({self.chunk})"
+            )
         self.cycles = cycles
 
     # -- host-side batch assembly ------------------------------------
@@ -160,9 +178,29 @@ class PrefetchLoader:
         out = self.dataset.batch(rng, self._local_batch)
         return apply_transform(self.transform, out)
 
+    def _make_item(self, c: int):
+        """Host-side assembly of yielded item ``c``: one batch, or a
+        ``chunk``-stacked group of consecutive step batches."""
+        if self.chunk == 1:
+            return self._make_batch(c)
+        nclasses = getattr(self.dataset, "nclasses", None)
+        ds = [
+            batch_to_dict(
+                self._make_batch(c * self.chunk + j), nclasses, self.one_hot
+            )
+            for j in range(self.chunk)
+        ]
+        return {k: np.stack([d[k] for d in ds]) for k in ds[0]}
+
     def _put(self, out):
         from ..parallel.multihost import global_batch_put
 
+        if self.chunk > 1:
+            # out is already a stacked dict; rows live on dim 1
+            return {
+                k: global_batch_put(v, self._chunk_sharding, batch_dim=1)
+                for k, v in out.items()
+            }
         d = batch_to_dict(
             out, getattr(self.dataset, "nclasses", None), self.one_hot
         )
@@ -170,11 +208,12 @@ class PrefetchLoader:
 
     # -- iteration ----------------------------------------------------
     def __len__(self) -> int:
-        return self.cycles
+        """Number of yielded items (= optimizer steps / chunk)."""
+        return self.cycles // self.chunk
 
     def __iter__(self) -> Iterator[dict]:
         q: queue.Queue = queue.Queue(maxsize=self.buffersize)
-        counter = iter(range(self.cycles))
+        counter = iter(range(len(self)))
         lock = threading.Lock()
         stop = threading.Event()
 
@@ -197,7 +236,7 @@ class PrefetchLoader:
                     # device_put from a worker thread: transfer overlaps
                     # the consumer's compute, like the reference's
                     # prefetch tasks
-                    item = (i, self._put(self._make_batch(i)), None)
+                    item = (i, self._put(self._make_item(i)), None)
                 except Exception as e:  # surface to the consumer, don't die silently
                     item = (i, None, e)
                 while not stop.is_set():
@@ -221,7 +260,7 @@ class PrefetchLoader:
         pending: dict = {}
         next_idx = 0
         try:
-            while next_idx < self.cycles:
+            while next_idx < len(self):
                 while next_idx not in pending:
                     i, batch, err = q.get()
                     if err is not None:

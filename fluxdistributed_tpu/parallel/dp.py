@@ -125,6 +125,7 @@ def make_train_step(
     accum_steps: int = 1,
     seed: int = 0,
     state_shardings=None,
+    steps_per_call: int = 1,
 ):
     """Compile the full DP training step under ``jit`` + shardings.
 
@@ -151,6 +152,15 @@ def make_train_step(
 
     ``seed`` roots the dropout/drop-path stream: two seeds draw different
     masks, the same seed reproduces a run exactly.
+
+    ``steps_per_call > 1`` runs K optimizer steps per dispatch — the
+    device loop: the returned function takes batches STACKED on a new
+    leading dim ``[K, batch, ...]`` (sharded ``P(None, axis)``, the
+    loader's ``chunk=K`` layout) and ``lax.scan``s the step over them,
+    returning metrics stacked ``[K]``.  Each step consumes a DIFFERENT
+    batch — semantics identical to K separate calls — but the host pays
+    one dispatch instead of K, which matters when dispatch crosses a
+    network tunnel or the host is slow relative to the step.
     """
     repl = NamedSharding(mesh, P())
     # axis=None: batch replicated (e.g. a pure 'expert' mesh where the
@@ -207,9 +217,24 @@ def make_train_step(
         )
         return new_state, {"loss": loss}
 
+    if steps_per_call == 1:
+        return jax.jit(
+            step,
+            in_shardings=(state_sh, shard),
+            out_shardings=(state_sh, repl),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    if steps_per_call < 1:
+        raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
+    chunk_shard = NamedSharding(mesh, P(None, axis) if axis is not None else P())
+
+    def chunked(state: TrainState, batches):
+        return jax.lax.scan(step, state, batches)
+
     return jax.jit(
-        step,
-        in_shardings=(state_sh, shard),
+        chunked,
+        in_shardings=(state_sh, chunk_shard),
         out_shardings=(state_sh, repl),
         donate_argnums=(0,) if donate else (),
     )

@@ -161,16 +161,21 @@ def global_batch(
     return jax.tree.map(lambda x: global_batch_put(x, sharding), local)
 
 
-def global_batch_put(x, sharding) -> jax.Array:
+def global_batch_put(x, sharding, batch_dim: int = 0) -> jax.Array:
     """Single-leaf version of :func:`global_batch` for callers that already
     hold a NamedSharding — the one canonical local-rows→global-array
-    boundary (loader and ``shard_batch`` both route through here)."""
+    boundary (loader and ``shard_batch`` both route through here).
+
+    ``batch_dim`` names the dim holding this process's local rows
+    (default 0; the loader's chunked ``[K, batch, ...]`` layout passes 1).
+    """
     x = np.asarray(x)
     nproc = jax.process_count()
     if nproc == 1:
         return jax.device_put(x, sharding)
-    global_shape = (x.shape[0] * nproc, *x.shape[1:])
-    return jax.make_array_from_process_local_data(sharding, x, global_shape)
+    global_shape = list(x.shape)
+    global_shape[batch_dim] *= nproc
+    return jax.make_array_from_process_local_data(sharding, x, tuple(global_shape))
 
 
 def host_local_values(x) -> np.ndarray:

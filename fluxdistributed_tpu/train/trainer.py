@@ -65,6 +65,9 @@ class TrainTask:
     # host-side batch hook (the loader's ``transform``), kept so
     # ``evaluate`` feeds the model the same layout training did
     transform: Optional[Callable] = None
+    # optimizer steps per dispatch (the device loop); loader items carry
+    # this many stacked batches and metrics come back stacked
+    steps_per_call: int = 1
 
 
 def prepare_training(
@@ -88,6 +91,7 @@ def prepare_training(
     topk: Sequence[int] = (1, 5, 10),
     accum_steps: int = 1,
     transform: Optional[Callable] = None,
+    steps_per_call: int = 1,
 ) -> TrainTask:
     """Initialize params, compile the SPMD step, build prefetch loaders.
 
@@ -120,9 +124,22 @@ def prepare_training(
     argument otherwise) — e.g. ``models.space_to_depth`` re-layout for a
     ``space_to_depth=True`` ResNet.  It is applied consistently to the
     init sample, the train loader, the val slice, and ``evaluate``.
+
+    ``steps_per_call > 1`` turns on the device loop: each loader item
+    stacks K per-step batches and the compiled program ``lax.scan``s K
+    optimizer steps per dispatch — identical math and identical sampled
+    data (sub-batch j of item c equals step c·K+j of an unchunked run),
+    but the host pays one dispatch per K steps.  Worthwhile when the
+    runtime sits behind a network tunnel or the host is slow; cadences
+    in ``train`` (print/eval/checkpoint) then tick once per K steps.
+    Supported for ``spmd='jit'``.
     """
     from ..data.loader import apply_transform
 
+    if steps_per_call < 1:
+        raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
+    if steps_per_call != 1 and spmd != "jit":
+        raise ValueError("steps_per_call > 1 requires spmd='jit'")
     mesh = mesh or mesh_lib.data_mesh()
     if input_shape is not None:
         dummy = np.zeros((1, *input_shape), np.float32)
@@ -217,6 +234,7 @@ def prepare_training(
             step_fn = make_train_step(
                 loss_fn, optimizer, mesh,
                 donate=donate, accum_steps=accum_steps, seed=seed,
+                steps_per_call=steps_per_call,
             )
         eval_fn = make_eval_step(loss_fn, mesh, topk=tuple(topk))
 
@@ -235,6 +253,7 @@ def prepare_training(
         buffersize=buffersize,
         seed=seed,
         transform=transform,
+        chunk=steps_per_call,
     )
 
     val_batch = None
@@ -270,6 +289,7 @@ def prepare_training(
         model=model,
         val_batch=val_batch,
         transform=transform,
+        steps_per_call=steps_per_call,
     )
 
 
@@ -454,6 +474,9 @@ def train(
     t_start = time.time()
     t_mark, j_mark = t_start, 0
     profiling = False
+    # device loop: each loader item is K stacked batches = K optimizer
+    # steps in one dispatch; cadences below tick per ITEM (= per K steps)
+    spc = getattr(task, "steps_per_call", 1)
 
     for j, batch in enumerate(task.loader):
         if print_every and j % print_every == 0:
@@ -461,9 +484,10 @@ def train(
             if j > j_mark:
                 # interval rates; the loop can only run ahead of the device
                 # by the dispatch queue, so interval averages are accurate
-                dsteps = j - j_mark
+                dsteps = (j - j_mark) * spc
                 dt = max(now - t_mark, 1e-9)
-                gbatch = int(jax.tree.leaves(batch)[0].shape[0])
+                lead = jax.tree.leaves(batch)[0]
+                gbatch = int(lead.shape[1] if spc > 1 else lead.shape[0])
                 logger.log(
                     {
                         "steps_per_sec": round(dsteps / dt, 3),
@@ -483,7 +507,7 @@ def train(
                 profiling = False
                 logger.info(f"profiler trace written to {profile_dir}")
         if sched is not None:
-            lr = sched(j)
+            lr = sched(j * spc)  # optimizer-step units, not loader items
             if verbose and lr is not None:
                 logger.log({"lr": float(lr)}, j)
         try:
@@ -511,15 +535,20 @@ def train(
                         "donated to the failed step and cannot be recovered — "
                         "re-run prepare_training(donate=False) for OOM-skip"
                     ) from e
-                task.num_missed += 1
+                task.num_missed += spc
                 logger.info(f"cycle {j}: device OOM — skipping batch ({task.num_missed} missed)")
                 continue
             raise
         if eval_every and j % eval_every == 0:
             if task.val_batch is not None:
                 _eval_and_log(task, task.val_batch, "val", j, topk, logger)
-            _eval_and_log(task, batch, "train", j, topk, logger)
-            logger.log({"train_step_loss": float(metrics["loss"])}, j)
+            # chunked items carry K batches; eval the last sub-batch (the
+            # eval step is compiled for the per-step layout)
+            eb = jax.tree.map(lambda x: x[-1], batch) if spc > 1 else batch
+            _eval_and_log(task, eb, "train", j, topk, logger)
+            loss_m = metrics["loss"]
+            last_loss = loss_m[-1] if getattr(loss_m, "ndim", 0) else loss_m
+            logger.log({"train_step_loss": float(last_loss)}, j)
         if checkpoint_dir and checkpoint_every and j > 0 and j % checkpoint_every == 0:
             from .checkpoint import save_checkpoint
 
