@@ -354,13 +354,16 @@ def evaluate(
 
     Coverage semantics: when the dataset supports explicit ``indices``
     and has a length, every sample is drawn EXACTLY once via sequential
-    index blocks (a trailing remainder smaller than one batch is
-    dropped).  Otherwise — generated token streams etc. — batches are
-    sampled and ``max_batches`` is required (the result is then a
-    stochastic estimate, flagged by ``"exact": False``).
+    index blocks; a trailing remainder runs as one extra smaller batch
+    (its own compile — shapes are static), so at most ``n_axis - 1``
+    samples are ever dropped (only when the dataset size itself is not a
+    data-axis multiple).  Otherwise — generated token streams etc. —
+    batches are sampled and ``max_batches`` is required (the result is
+    then a stochastic estimate, flagged by ``"exact": False``).
 
     Returns sample-weighted means ``{"loss": ..., "top1": ..., ...}``
-    plus ``"samples"`` and ``"exact"``.  Requested top-k metrics must
+    plus ``"samples"``, ``"exact"``, and (on the exact path) ``"dropped"``
+    — the < n_axis unreachable leftovers.  Requested top-k metrics must
     have been compiled into the eval step (``prepare_training(topk=...)``).
     """
     import inspect
@@ -392,8 +395,12 @@ def evaluate(
             f"batch_size {requested} rounds down to 0 on the "
             f"{n_axis}-way data axis; pass batch_size >= {n_axis}"
         )
+    rem_size = 0
     if capable:
         full_batches = len(dataset) // batch_size
+        # trailing remainder, rounded to a shardable size: runs as one
+        # extra smaller batch so coverage misses < n_axis samples
+        rem_size = (len(dataset) - full_batches * batch_size) // n_axis * n_axis
     if max_batches is None:
         if not hasattr(dataset, "__len__"):
             raise ValueError(
@@ -402,8 +409,10 @@ def evaluate(
         max_batches = full_batches if capable else max(1, len(dataset) // batch_size)
     if capable:
         max_batches = min(max_batches, full_batches)
-    # "exact" promises once-per-sample coverage of every full batch — a
-    # caller-truncated run is a sampled estimate of a different kind
+        if max_batches < full_batches:
+            rem_size = 0  # caller truncated: no remainder pass
+    # "exact" promises once-per-sample coverage up to < n_axis leftovers —
+    # a caller-truncated run is a sampled estimate of a different kind
     exact = capable and max_batches == full_batches
     rng = np.random.default_rng(seed)
     was_augment = getattr(dataset, "augment", False)
@@ -412,31 +421,47 @@ def evaluate(
     try:
         total = {"loss": 0.0}
         n = 0
+
+        def accumulate(draw, bs, first):
+            nonlocal n
+            draw = apply_transform(task.transform, draw)
+            batch = sharding_lib.shard_batch(
+                batch_to_dict(draw, getattr(dataset, "nclasses", None)), task.mesh
+            )
+            loss, accs = task.eval_fn(task.state, batch)
+            if first:
+                _require_topk(accs, topk)
+            total["loss"] += float(loss) * bs
+            for k in topk:
+                total[f"top{k}"] = (
+                    total.get(f"top{k}", 0.0) + float(accs[f"top{k}"]) * bs
+                )
+            n += bs
+
         for i in range(max_batches):
             if exact:
                 idx = np.arange(i * batch_size, (i + 1) * batch_size)
                 draw = dataset.batch(rng, batch_size, indices=idx)
             else:
                 draw = dataset.batch(rng, batch_size)
-            draw = apply_transform(task.transform, draw)
-            batch = sharding_lib.shard_batch(
-                batch_to_dict(draw, getattr(dataset, "nclasses", None)), task.mesh
+            accumulate(draw, batch_size, first=i == 0)
+        if exact and rem_size:
+            start = max_batches * batch_size
+            idx = np.arange(start, start + rem_size)
+            accumulate(
+                dataset.batch(rng, rem_size, indices=idx), rem_size,
+                first=max_batches == 0,
             )
-            loss, accs = task.eval_fn(task.state, batch)
-            if i == 0:
-                _require_topk(accs, topk)
-            total["loss"] += float(loss) * batch_size
-            for k in topk:
-                total[f"top{k}"] = (
-                    total.get(f"top{k}", 0.0) + float(accs[f"top{k}"]) * batch_size
-                )
-            n += batch_size
     finally:
         if was_augment:
             dataset.augment = True
     out = {key: v / max(n, 1) for key, v in total.items()}
     out["samples"] = n
     out["exact"] = exact
+    if exact:
+        # < n_axis samples can be unreachable when the dataset size is
+        # not a data-axis multiple; report the honest count
+        out["dropped"] = len(dataset) - n
     return out
 
 

@@ -231,6 +231,16 @@ def test_evaluate_whole_dataset(mesh):
     assert out_odd["samples"] % n_axis == 0 and out_odd["samples"] > 0
     with pytest.raises(ValueError, match="rounds down"):
         evaluate(task, ds, batch_size=n_axis - 1, topk=(1,))
+    # a trailing remainder runs as one extra smaller batch: 104 samples
+    # at batch 32 = 3 full batches + 8-sample remainder, nothing dropped
+    rem_ds = SyntheticDataset(nsamples=104, nclasses=4, shape=(8, 8, 3))
+    out_rem = evaluate(task, rem_ds, batch_size=32, topk=(1,))
+    assert out_rem["samples"] == 104 and out_rem["exact"] is True
+    assert out_rem["dropped"] == 0
+    # only a sub-n_axis tail (101 = 96 + 5 with n_axis=8) is unreachable
+    tail_ds = SyntheticDataset(nsamples=101, nclasses=4, shape=(8, 8, 3))
+    out_tail = evaluate(task, tail_ds, batch_size=32, topk=(1,))
+    assert out_tail["samples"] == 96 and out_tail["dropped"] == 5
     # trained on a learnable task -> much better than the 25% chance floor
     assert out["top1"] > 0.8, out
 
