@@ -507,6 +507,7 @@ def lm_pp(
     pipe_axis: str = "pipe",
     batch_axis: Optional[str] = None,
     num_microbatches: Optional[int] = None,
+    remat: bool = False,
 ):
     """Pipeline-parallelize the LM: blocks ride the GPipe schedule.
 
@@ -566,7 +567,7 @@ def lm_pp(
     fwd = pipeline_apply(
         base_fn if V == 1 else chunk_stages(base_fn),
         mesh, axis=pipe_axis, num_microbatches=num_microbatches,
-        batch_axis=batch_axis,
+        batch_axis=batch_axis, remat=remat,
     )
     embed = nn.Embed(model.vocab, model.dim, dtype=model.dtype)
     ln = nn.LayerNorm(dtype=model.dtype)

@@ -448,9 +448,10 @@ def evaluate(
         if exact and rem_size:
             start = max_batches * batch_size
             idx = np.arange(start, start + rem_size)
+            # full_batches >= 1 on the exact path, so topk was already
+            # validated by the first full batch
             accumulate(
-                dataset.batch(rng, rem_size, indices=idx), rem_size,
-                first=max_batches == 0,
+                dataset.batch(rng, rem_size, indices=idx), rem_size, first=False
             )
     finally:
         if was_augment:
