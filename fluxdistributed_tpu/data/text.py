@@ -48,10 +48,24 @@ class ByteTextDataset:
         # intra-window, so no trailing target byte is reserved
         return len(self._data) // self.seqlen
 
-    def batch(self, rng: np.random.Generator, n: int) -> np.ndarray:
-        # inclusive upper bound: the last valid window start is
-        # len - seqlen, so the corpus's final byte is reachable
-        starts = rng.integers(0, len(self._data) - self.seqlen + 1, size=n)
+    def batch(self, rng: np.random.Generator, n: int, indices=None) -> np.ndarray:
+        """Random windows by default; ``indices`` selects the
+        NON-OVERLAPPING windows ``indices[i]·seqlen`` (the ``len(self)``
+        windows ``__len__`` counts) — the deterministic-coverage protocol
+        ``train.evaluate`` uses for exact whole-corpus perplexity."""
+        if indices is None:
+            # inclusive upper bound: the last valid window start is
+            # len - seqlen, so the corpus's final byte is reachable
+            starts = rng.integers(0, len(self._data) - self.seqlen + 1, size=n)
+        else:
+            indices = np.asarray(indices)
+            if (indices.max(initial=0) >= len(self)
+                    or indices.min(initial=0) < 0):
+                raise IndexError(
+                    f"window indices must be in [0, {len(self)}); got "
+                    f"[{int(indices.min())}, {int(indices.max())}]"
+                )
+            starts = indices * self.seqlen
         idx = starts[:, None] + np.arange(self.seqlen)[None, :]
         return self._data[idx].astype(np.int32)
 

@@ -259,3 +259,27 @@ def test_evaluate_whole_dataset(mesh):
     # batch_size down too, not crash in shard_batch mid-eval
     out_odd = evaluate(lm_task, tds, batch_size=17, max_batches=1, topk=())
     assert out_odd["samples"] == 16
+
+
+def test_evaluate_exact_lm_corpus(mesh, tmp_path):
+    """ByteTextDataset's indices protocol makes LM evaluation exact:
+    every non-overlapping window of the corpus is scored once."""
+    from fluxdistributed_tpu.data import ByteTextDataset
+    from fluxdistributed_tpu.models import lm_loss_fn, lm_tiny
+    from fluxdistributed_tpu.train import evaluate
+
+    p = tmp_path / "corpus.txt"
+    p.write_bytes(b"x" * (16 * 104))  # exactly 104 windows
+    ds = ByteTextDataset(str(p), seqlen=16)
+    lm = lm_tiny(vocab=256, dtype=np.float32)
+    task = prepare_training(
+        lm, ds, optim.adam(1e-3), mesh=mesh, batch_size=16, cycles=1,
+        loss_fn=lm_loss_fn(lm), topk=(),
+    )
+    out = evaluate(task, ds, batch_size=32, topk=())
+    # 104 windows: 3 full 32-batches + one 8-window remainder batch
+    assert out["exact"] is True
+    assert out["samples"] == 104 and out["dropped"] == 0
+    assert np.isfinite(out["loss"]) and out["loss"] > 0
+    with pytest.raises(IndexError, match="window indices"):
+        ds.batch(np.random.default_rng(0), 1, indices=[-1])
