@@ -35,6 +35,9 @@ CONFIGS = [
     # replaces the 7x7/2-on-3-channels stem pathology (exact re-layout,
     # tests/test_resnet_s2d.py)
     {"name": "s2d-stem", "env": {"SWEEP_S2D": "1"}},
+    # combined best-case candidates: stem fix x batch x fused dispatch
+    {"name": "s2d-512", "env": {"SWEEP_S2D": "1", "SWEEP_BATCH": "512"}},
+    {"name": "s2d-fuse-8", "env": {"SWEEP_S2D": "1", "SWEEP_FUSE": "8"}},
     {"name": "latency-hiding-sched", "env": {
         "SWEEP_XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=true"}},
     {"name": "batch-512", "env": {"SWEEP_BATCH": "512"}},

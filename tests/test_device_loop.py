@@ -103,3 +103,38 @@ def test_device_loop_through_trainer(mesh):
             mesh=mesh, batch_size=16, cycles=8, steps_per_call=2,
             spmd="shard_map",
         )
+
+
+def test_device_loop_composes_with_grad_accum(mesh):
+    """steps_per_call scans whole steps; accum_steps microbatches within
+    each step — composed, they must still match plain sequential steps."""
+    model = SimpleCNN(num_classes=4)
+    rng = np.random.default_rng(1)
+    xs = rng.normal(0, 1, (2, 32, 8, 8, 3)).astype(np.float32)
+    ys = np.stack([
+        np.asarray(fd.onehot(rng.integers(0, 4, 32), 4)) for _ in range(2)
+    ])
+    params = model.init(jax.random.PRNGKey(0), xs[0, :1], train=True)["params"]
+    loss_fn = flax_loss_fn(model, fd.logitcrossentropy)
+    opt = optim.momentum(0.1, 0.9)
+
+    plain = make_train_step(loss_fn, opt, mesh, donate=False, accum_steps=2)
+    state = TrainState.create(sharding.replicate(params, mesh), opt)
+    for j in range(2):
+        b = sharding.shard_batch({"image": xs[j], "label": ys[j]}, mesh)
+        state, _ = plain(state, b)
+
+    both = make_train_step(
+        loss_fn, opt, mesh, donate=False, accum_steps=2, steps_per_call=2
+    )
+    state_c = TrainState.create(sharding.replicate(params, mesh), opt)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    stacked = {
+        "image": jax.device_put(xs, NamedSharding(mesh, P(None, "data"))),
+        "label": jax.device_put(ys, NamedSharding(mesh, P(None, "data"))),
+    }
+    state_c, m = both(state_c, stacked)
+    assert int(state_c.step) == 2 and m["loss"].shape == (2,)
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(state_c.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
