@@ -44,6 +44,7 @@ timeout 2700 python benchmarks/lm_bench.py --model lm_medium --seqlen 1024 --bat
 
 echo "[$(stamp)] 6/7 end-to-end ingest" | tee -a "$OUT/session.log"
 timeout 3600 python benchmarks/ingest_e2e.py --steps 20 >> "$OUT/ingest.jsonl" 2>> "$OUT/session.log"
+timeout 3600 python benchmarks/ingest_e2e.py --steps 20 --s2d >> "$OUT/ingest.jsonl" 2>> "$OUT/session.log"
 
 
 echo "[$(stamp)] 7/7 attention-core microbench" | tee -a "$OUT/session.log"

@@ -73,6 +73,9 @@ def main():
                     help="prefetch assembly threads in the loader")
     ap.add_argument("--root", default=None,
                     help="existing ILSVRC-layout tree (default: generate one)")
+    ap.add_argument("--s2d", action="store_true",
+                    help="space_to_depth model + host-side re-layout in the "
+                         "loader transform (the full MXU-stem input path)")
     args = ap.parse_args()
 
     import jax
@@ -109,9 +112,17 @@ def main():
     )
 
     mesh = fd.data_mesh()
-    model = resnet50(num_classes=len(lt))
+    model = resnet50(num_classes=len(lt), space_to_depth=args.s2d)
     rng = np.random.default_rng(0)
     x0 = rng.normal(0, 1, (args.batch, args.size, args.size, 3)).astype(np.float32)
+    transform = None
+    if args.s2d:
+        from fluxdistributed_tpu.models import space_to_depth
+
+        x0 = np.ascontiguousarray(space_to_depth(x0))
+
+        def transform(imgs, labels):
+            return np.ascontiguousarray(space_to_depth(imgs)), labels
     variables = model.init(jax.random.PRNGKey(0), x0[:1], train=True)
     params = variables["params"]
     mstate = {k: v for k, v in variables.items() if k != "params"}
@@ -150,6 +161,7 @@ def main():
     loader = PrefetchLoader(
         ds, mesh, args.batch, cycles=args.steps + warm,
         buffersize=buffersize, num_threads=args.loader_threads,
+        transform=transform,
     )
     it = iter(loader)
     for _ in range(warm):
@@ -176,6 +188,7 @@ def main():
         "decode_threads": args.threads,
         "loader_threads": args.loader_threads,
         "native": bool(native_available()),
+        "s2d": bool(args.s2d),
         "platform": jax.devices()[0].platform,
     }
     print(json.dumps(out))
