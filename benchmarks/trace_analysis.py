@@ -34,9 +34,13 @@ def capture(args) -> str:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     mesh = fd.data_mesh()
-    model = getattr(models_lib, args.model)(num_classes=1000)
+    model = getattr(models_lib, args.model)(
+        num_classes=1000, space_to_depth=args.s2d
+    )
     rng = np.random.default_rng(0)
     x = rng.normal(0, 1, (args.batch, args.size, args.size, 3)).astype(np.float32)
+    if args.s2d:
+        x = np.ascontiguousarray(models_lib.space_to_depth(x))
     y = rng.integers(0, 1000, args.batch)
     variables = model.init(jax.random.PRNGKey(0), x[:1], train=True)
     params = variables["params"]
@@ -150,6 +154,8 @@ def main():
     ap.add_argument("--model", default="resnet50")
     ap.add_argument("--top", type=int, default=30)
     ap.add_argument("--platform", default=None)
+    ap.add_argument("--s2d", action="store_true",
+                    help="trace the space_to_depth-stem model instead")
     ap.add_argument("--trace-dir", default=None)
     ap.add_argument("--analyze-only", default=None,
                     help="skip capture; analyze this trace dir")
