@@ -76,6 +76,29 @@ def main() -> int:
     fps = multihost.host_local_values(np.asarray([fp], np.float32))
     assert np.allclose(fps, fps[0]), fps
 
+    # -- device loop across processes: the chunked loader's stacked
+    #    [K, batch, ...] layout places per-process rows on dim 1 via
+    #    global_batch_put(batch_dim=1) — the path only multi-process
+    #    runs exercise — and the scanned step advances K steps/dispatch
+    task_dl = prepare_training(
+        SimpleCNN(num_classes=10),
+        ds,
+        optim.momentum(0.05, 0.9),
+        mesh=mesh,
+        batch_size=4 * nproc,
+        cycles=4,
+        steps_per_call=2,
+    )
+    item = next(iter(task_dl.loader))
+    assert item["image"].shape == (2, 4 * nproc, 16, 16, 3), item["image"].shape
+    train(task_dl, print_every=0, eval_every=0, logger=NullLogger())
+    assert int(task_dl.state.step) == 4
+    leaf = jax.tree.leaves(task_dl.state.params)[0]
+    fp = float(jnp.sum(jnp.abs(leaf)))
+    fps = multihost.host_local_values(np.asarray([fp], np.float32))
+    assert np.allclose(fps, fps[0]), fps
+    print(f"worker {pid}: device-loop OK", flush=True)
+
     # -- cooperative abort: any process voting stop stops everyone
     assert multihost.agree_to_stop(pid == 0) is True
     assert multihost.agree_to_stop(False) is False

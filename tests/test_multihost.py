@@ -71,6 +71,7 @@ def test_two_process_training_and_collectives():
     )
     for i, out in enumerate(outs):
         assert f"worker {i}: OK" in out, out[-2000:]
+        assert f"worker {i}: device-loop OK" in out, out[-2000:]
 
 
 @pytest.mark.slow
