@@ -254,17 +254,28 @@ def main():
             break
         print(f"bench attempt {attempt + 1} failed; retrying", file=sys.stderr)
         time.sleep(5)
-    print(
-        json.dumps(
-            {
-                "metric": "ResNet-50 train-step throughput",
-                "value": 0.0,
-                "unit": "images/sec/chip",
-                "vs_baseline": 0.0,
-                "error": str(last_err),
-            }
-        )
-    )
+    out = {
+        "metric": "ResNet-50 train-step throughput",
+        "value": 0.0,
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+        "error": str(last_err),
+    }
+    # If a background probe loop has been retrying the chip (the r4+
+    # availability workflow, docs/benchmarks.md), attach its evidence so
+    # a zero artifact shows the outage was continuously probed, not
+    # unattended.
+    try:
+        log = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           ".bench_probe_r4.log")
+        with open(log) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+        if lines:
+            out["probe_attempts"] = len(lines)
+            out["probe_last"] = lines[-1][:200]
+    except OSError:
+        pass
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
