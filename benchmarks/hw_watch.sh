@@ -1,0 +1,48 @@
+#!/bin/sh
+# Unattended availability watcher (round-4 workflow, docs/benchmarks.md):
+# keep attempting the headline measurement; the FIRST success proves the
+# chip is granting, after which the FULL staged session runs
+# (benchmarks/hw_session.sh).  Survives the driver's turn boundaries via
+# nohup; one TPU client at a time is preserved by (a) waiting for any
+# pre-existing bench process and (b) an flock on this script's lockfile.
+#
+#   nohup sh benchmarks/hw_watch.sh >> benchmarks/hw/watch.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+OUT="benchmarks/hw"
+mkdir -p "$OUT"
+LOCK="$OUT/.watch.lock"
+exec 9> "$LOCK"
+if ! flock -n 9; then
+    echo "watch: another watcher holds $LOCK; exiting"
+    exit 0
+fi
+stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+
+# wait for any in-flight bench client (grant contention wedges init)
+while pgrep -f "bench\.py --one" > /dev/null 2>&1; do
+    echo "[$(stamp)] watch: waiting for in-flight bench client"
+    sleep 60
+done
+
+attempt=0
+while :; do
+    attempt=$((attempt + 1))
+    echo "[$(stamp)] watch: bench attempt $attempt"
+    timeout 2400 python bench.py --one > "$OUT/.try.json" 2>> "$OUT/watch.err"
+    rc=$?
+    if [ "$rc" = 0 ] && grep -q '"value"' "$OUT/.try.json" 2>/dev/null; then
+        echo "[$(stamp)] watch: SUCCESS on attempt $attempt"
+        cat "$OUT/.try.json" >> "$OUT/bench.jsonl"
+        cat "$OUT/.try.json"
+        break
+    fi
+    echo "[$(stamp)] watch: attempt $attempt failed rc=$rc ($(tail -c 200 "$OUT/watch.err" | tr '\n' ' '))"
+    sleep 300
+done
+
+# chip is granting: run the rest of the staged chain (stage 1 re-runs
+# bench.py, giving the required second reproduction of the headline)
+echo "[$(stamp)] watch: launching full hw_session"
+sh benchmarks/hw_session.sh "$OUT"
+echo "[$(stamp)] watch: hw_session complete"
