@@ -501,6 +501,85 @@ def generate(
     return out
 
 
+def _pp_validate_and_stage(model: "TransformerLM", mesh, pipe_axis: str, what: str):
+    """Shared lm_pp/lm_pp_1f1b front half: validate the model is
+    pipelineable, and build the per-stage callable.  Returns
+    ``(S, V, stage_fn)`` — V logical blocks hosted per pipe device,
+    ``stage_fn`` already ``chunk_stages``-blocked when V > 1."""
+    from ..parallel.pp import chunk_stages
+
+    if not model.use_rope:
+        raise ValueError(f"{what} needs use_rope=True (a positional table "
+                         "would have to enter mid-pipeline)")
+    if model.dropout:
+        raise ValueError(f"{what} supports dropout=0 only (no rng stream "
+                         "threads through the pipeline schedule)")
+    if model.moe_every:
+        raise ValueError(
+            f"{what} does not support moe_every > 0: MoE and dense blocks "
+            "have different param trees, so blocks cannot stack as "
+            "homogeneous pipe stages"
+        )
+    S = mesh.shape[pipe_axis]
+    if model.depth % S:
+        raise ValueError(
+            f"model.depth ({model.depth}) must be a multiple of the "
+            f"'{pipe_axis}' axis size ({S})"
+        )
+    V = model.depth // S
+
+    blk = DecoderBlock(
+        model.num_heads, model.mlp_dim, dtype=model.dtype,
+        dropout=0.0, use_rope=model.use_rope, attn_fn=model.attn_fn,
+    )
+
+    def base_fn(p, x):
+        return blk.apply({"params": p}, x, train=False)
+
+    return S, V, (base_fn if V == 1 else chunk_stages(base_fn))
+
+
+def _pp_split_params(model: "TransformerLM", mesh, pipe_axis: str, S: int, V: int):
+    """Shared splitter: full param tree -> ``{"outer", "stages"}`` with
+    block trees stacked (chunked ``(S, V, ...)`` when V > 1) on a
+    leading dim sharded over ``pipe_axis``.  Both pipeline schedules use
+    this same tree, so their checkpoints/shardings are interchangeable."""
+    from ..parallel.pp import stack_stage_params
+
+    def split_params(params):
+        stages = [params[f"block{i}"] for i in range(model.depth)]
+        outer = {k: v for k, v in params.items() if not k.startswith("block")}
+        if V > 1:
+            stages = [
+                jax.tree.map(lambda *xs: jnp.stack(xs), *stages[s * V : (s + 1) * V])
+                for s in range(S)
+            ]
+        return {
+            "outer": outer,
+            "stages": stack_stage_params(stages, mesh, pipe_axis),
+        }
+
+    return split_params
+
+
+def _pp_state_shardings(mesh, pipe_axis: str):
+    """Shared TrainState sharding builder for the split tree: outer
+    replicated, stages pipe-sharded, optimizer state following."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.tp import state_specs
+    from ..sharding import make_shardings
+
+    def state_shardings(state):
+        p_specs = {
+            "outer": jax.tree.map(lambda _: P(), state.params["outer"]),
+            "stages": jax.tree.map(lambda _: P(pipe_axis), state.params["stages"]),
+        }
+        return make_shardings(state_specs(state, p_specs), mesh)
+
+    return state_shardings
+
+
 def lm_pp(
     model: TransformerLM,
     mesh,
@@ -532,60 +611,16 @@ def lm_pp(
     Constraints: ``use_rope`` (positions live inside the blocks) and
     ``dropout == 0`` (no rng stream threads through the pipeline ticks).
     """
-    from jax.sharding import PartitionSpec as P
+    from ..parallel.pp import pipeline_apply
 
-    from ..parallel.pp import chunk_stages, pipeline_apply, stack_stage_params
-
-    if not model.use_rope:
-        raise ValueError("lm_pp needs use_rope=True (a positional table "
-                         "would have to enter mid-pipeline)")
-    if model.dropout:
-        raise ValueError("lm_pp supports dropout=0 only (no rng stream "
-                         "threads through the pipeline schedule)")
-    if model.moe_every:
-        raise ValueError(
-            "lm_pp does not support moe_every > 0: MoE and dense blocks "
-            "have different param trees, so blocks cannot stack as "
-            "homogeneous pipe stages"
-        )
-    S = mesh.shape[pipe_axis]
-    if model.depth % S:
-        raise ValueError(
-            f"model.depth ({model.depth}) must be a multiple of the "
-            f"'{pipe_axis}' axis size ({S})"
-        )
-    V = model.depth // S  # logical blocks hosted per pipe device
-
-    blk = DecoderBlock(
-        model.num_heads, model.mlp_dim, dtype=model.dtype,
-        dropout=0.0, use_rope=model.use_rope, attn_fn=model.attn_fn,
-    )
-
-    def base_fn(p, x):
-        return blk.apply({"params": p}, x, train=False)
-
+    S, V, stage_fn = _pp_validate_and_stage(model, mesh, pipe_axis, "lm_pp")
     fwd = pipeline_apply(
-        base_fn if V == 1 else chunk_stages(base_fn),
-        mesh, axis=pipe_axis, num_microbatches=num_microbatches,
+        stage_fn, mesh, axis=pipe_axis, num_microbatches=num_microbatches,
         batch_axis=batch_axis, remat=remat,
     )
     embed = nn.Embed(model.vocab, model.dim, dtype=model.dtype)
     ln = nn.LayerNorm(dtype=model.dtype)
-
-    def split_params(params):
-        stages = [params[f"block{i}"] for i in range(model.depth)]
-        outer = {k: v for k, v in params.items() if not k.startswith("block")}
-        if V > 1:
-            # blocked virtual pipeline: device s hosts logical blocks
-            # s·V … s·V+V-1 as a (V, ...) chunk it scans over each tick
-            stages = [
-                jax.tree.map(lambda *xs: jnp.stack(xs), *stages[s * V : (s + 1) * V])
-                for s in range(S)
-            ]
-        return {
-            "outer": outer,
-            "stages": stack_stage_params(stages, mesh, pipe_axis),
-        }
+    split_params = _pp_split_params(model, mesh, pipe_axis, S, V)
 
     def loss_fn(params, model_state, batch, train: bool, rng=None):
         tokens = batch["tokens"]
@@ -604,17 +639,58 @@ def lm_pp(
             model_state, logits,
         )
 
-    def state_shardings(state):
-        from ..parallel.tp import state_specs
-        from ..sharding import make_shardings
+    return split_params, loss_fn, _pp_state_shardings(mesh, pipe_axis)
 
-        p_specs = {
-            "outer": jax.tree.map(lambda _: P(), state.params["outer"]),
-            "stages": jax.tree.map(lambda _: P(pipe_axis), state.params["stages"]),
-        }
-        return make_shardings(state_specs(state, p_specs), mesh)
 
-    return split_params, loss_fn, state_shardings
+def lm_pp_1f1b(
+    model: TransformerLM,
+    mesh,
+    pipe_axis: str = "pipe",
+):
+    """Pipeline-parallelize the LM on the hand-scheduled 1F1B schedule
+    (``parallel.pp_1f1b``) instead of GPipe-via-AD (``lm_pp``).
+
+    Same stage decomposition and the SAME ``split_params`` tree as
+    ``lm_pp`` — checkpoints and shardings are interchangeable between
+    the two schedules — but activation memory is O(S) ring slots per
+    device instead of O(M·ticks) scan residuals, so the microbatch
+    count (and with it the bubble (S-1)/(M+S-1)) can grow freely.
+
+    Because 1F1B interleaves forwards and backwards, the embedding and
+    the final-norm/logits/loss run INSIDE the schedule, per microbatch,
+    on pipe devices 0 and S-1; their ("outer") grads are psum'd across
+    the pipe axis, which also makes tied embeddings sum correctly.
+
+    Returns ``(split_params, fns, state_shardings)`` where ``fns`` is
+    the ``(stage_fn, embed_fn, head_fn)`` triple for
+    ``parallel.pp_1f1b.make_train_step_1f1b`` — pass ``num_microbatches``
+    and ``batch_axis`` THERE (they parameterize the schedule, not the
+    stage decomposition).  Constraints are ``lm_pp``'s (rope, no
+    dropout, no MoE) plus: no ``batch["mask"]`` support (the
+    per-microbatch loss reads tokens only).
+    """
+    S, V, stage_fn = _pp_validate_and_stage(model, mesh, pipe_axis, "lm_pp_1f1b")
+    embed = nn.Embed(model.vocab, model.dim, dtype=model.dtype)
+    ln = nn.LayerNorm(dtype=model.dtype)
+
+    def embed_fn(outer, tokens_mb):
+        return embed.apply({"params": outer["embed"]}, tokens_mb)
+
+    def head_fn(outer, y, tokens_mb):
+        x = ln.apply({"params": outer["final_ln"]}, y)
+        if model.tie_embeddings:
+            logits = embed.apply({"params": outer["embed"]}, x, method="attend")
+        else:
+            logits = nn.Dense(model.vocab, dtype=model.dtype).apply(
+                {"params": outer["head"]}, x
+            )
+        return next_token_loss(jnp.asarray(logits, jnp.float32), tokens_mb)
+
+    return (
+        _pp_split_params(model, mesh, pipe_axis, S, V),
+        (stage_fn, embed_fn, head_fn),
+        _pp_state_shardings(mesh, pipe_axis),
+    )
 
 
 def lm_moe_specs(params, axis: str = "expert"):

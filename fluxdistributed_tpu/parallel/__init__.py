@@ -16,6 +16,7 @@ from .ep import (
     stack_expert_params,
 )
 from .pp import make_train_step_pp, pipeline_apply, stack_stage_params, switch_stage
+from .pp_1f1b import build_schedule, make_train_step_1f1b, pipeline_grads_1f1b
 from .tp import lm_tp_rules, make_train_step_tp, param_specs, shard_state, vit_tp_rules
 
 __all__ = [
@@ -45,6 +46,9 @@ __all__ = [
     "lm_tp_rules",
     "pipeline_apply",
     "make_train_step_pp",
+    "build_schedule",
+    "pipeline_grads_1f1b",
+    "make_train_step_1f1b",
     "stack_stage_params",
     "switch_stage",
     "moe_apply",

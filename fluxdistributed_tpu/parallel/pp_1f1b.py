@@ -1,0 +1,468 @@
+"""Hand-scheduled 1F1B pipeline parallelism.
+
+The GPipe schedule in ``parallel.pp`` derives its backward pass from AD:
+differentiate through the forward ``lax.scan`` and the reverse pipeline
+falls out.  Elegant — but the scan transpose stores residuals for every
+tick, so activation memory grows with the microbatch count M.  That is
+GPipe's textbook pathology, and it is measurable: on the benchmark mesh,
+per-tick cost inflates >2x from M=S to M=8S as the stashed residuals
+grow (benchmarks/pp_bubble.py, docs/parallelism.md).
+
+This module hand-writes the 1F1B (one-forward-one-backward) schedule
+instead, the way Megatron-LM runs its pipelines — but TPU-idiomatic:
+the whole schedule (all forwards, all backwards, gradient accumulation)
+is ONE ``lax.scan`` over lockstep ticks inside ONE ``shard_map``, with
+neighbor transfers as ``ppermute`` collectives.  Per tick each pipe
+device performs one stage-forward, one stage-backward, or idles,
+according to a STATIC schedule table computed in Python at trace time
+(S and M are static, so the whole timetable is).  Nothing here is
+data-dependent control flow: per-device divergence is a ``lax.cond`` on
+a device-varying flag read from the table.
+
+Memory property (the point of 1F1B): a device stashes at most
+``min(S, M)`` in-flight microbatch INPUTS — a fixed-size ring buffer —
+instead of the O(M·ticks) residuals of AD-through-scan.  Backward ticks
+recompute the stage forward under ``jax.vjp`` from the stored input
+(same recompute trade as ``pipeline_apply(remat=True)``, which is how
+Megatron runs production pipelines too: activation recompute +
+schedule).  Net: activation memory O(S), not O(M), so M — and with it
+the (S-1)/(M+S-1) bubble — can grow freely.
+
+Because forward and backward interleave *within* the schedule, the loss
+must be computable per-microbatch inside the pipeline: the caller
+provides ``embed_fn`` (applied at stage 0, e.g. token embedding) and
+``head_fn`` (applied at stage S-1: final norm + logits + scalar loss).
+Stage-parameter gradients stay local to their pipe device (no gradient
+collective at all); ``embed_fn``/``head_fn`` ("outer") parameter
+gradients accumulate on devices 0 and S-1 and are summed across the
+pipe axis once at the end — which also makes weight tying (embedding
+matrix used by both ends) come out right for free.
+
+Reference anchor: net-new scope beyond FluxDistributed.jl (SURVEY §2
+"PP: NO"); the reference never pipelines.  Schedule follows the
+published 1F1B form (PipeDream-flush / Megatron-LM); implementation is
+original and TPU-first.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..optim import Optimizer
+from .dp import TrainState
+from .pp import PIPE_AXIS, _accepts_stage
+
+Pytree = Any
+
+__all__ = ["Schedule1F1B", "build_schedule", "pipeline_grads_1f1b",
+           "make_train_step_1f1b"]
+
+
+class Schedule1F1B(NamedTuple):
+    """Static lockstep timetable: ``[T, S]`` arrays, one row per tick.
+
+    ``is_fwd[t, i]``/``is_bwd[t, i]`` — does device i run a stage
+    forward / backward at tick t (at most one of the two is set);
+    ``fwd_mb``/``bwd_mb`` — which microbatch (0 when inactive);
+    ``fwd_slot``/``bwd_slot`` — its ring-buffer slot (mb mod ring);
+    ``left_fwd[t, i]`` = is_fwd[t, i-1]: the left neighbor produced an
+    activation this tick, so latch the incoming ppermute value;
+    ``right_bwd[t, i]`` = is_bwd[t, i+1]: same for cotangents.
+    """
+
+    is_fwd: np.ndarray
+    is_bwd: np.ndarray
+    fwd_mb: np.ndarray
+    bwd_mb: np.ndarray
+    fwd_slot: np.ndarray
+    bwd_slot: np.ndarray
+    left_fwd: np.ndarray
+    right_bwd: np.ndarray
+
+    @property
+    def ticks(self) -> int:
+        return self.is_fwd.shape[0]
+
+
+def build_schedule(S: int, M: int) -> Schedule1F1B:
+    """Build and VERIFY the lockstep 1F1B timetable for S stages and M
+    microbatches.
+
+    Per-device action order is the classic warmup/steady/cooldown
+    sequence — device i runs ``W = min(S-1-i, M)`` warmup forwards, then
+    alternates forward/backward until forwards run out, then drains
+    backwards.  Actions are placed onto lockstep ticks greedily, each
+    device firing its next action as soon as its dependency (upstream
+    forward / downstream backward, strictly earlier tick) is met.
+
+    The builder then PROVES the placement safe for the runtime's
+    fixed-size buffers, asserting for every edge and every slot:
+
+    * single-latch safety: a produced activation/cotangent is consumed
+      before (or exactly when) the producer's next value lands;
+    * ring safety: a stored input's slot is not reused until its own
+      backward has retired it.
+
+    Greedy lockstep placement lands on the canonical 2(M+S-1) ticks
+    (bubble fraction (S-1)/(M+S-1), same as GPipe — 1F1B's win is
+    memory, not bubble).
+    """
+    if S < 2:
+        raise ValueError(f"1F1B needs >= 2 pipeline stages, got {S}")
+    if M < 1:
+        raise ValueError(f"need >= 1 microbatch, got {M}")
+
+    # per-device action sequences: [F]*W + [F,B]*(M-W) + [B]*W
+    seqs = []
+    for i in range(S):
+        w = min(S - 1 - i, M)
+        seq = [("F", m) for m in range(w)]
+        nxt = w
+        for m in range(M - w):
+            seq.append(("F", nxt))
+            nxt += 1
+            seq.append(("B", m))
+        seq.extend(("B", m) for m in range(max(0, M - w), M))
+        seqs.append(seq)
+
+    pos = [0] * S
+    fdone = [[-1] * M for _ in range(S)]
+    bdone = [[-1] * M for _ in range(S)]
+    rows_f, rows_b, rows_mf, rows_mb = [], [], [], []
+    t = 0
+    while any(pos[i] < len(seqs[i]) for i in range(S)):
+        if t > 4 * (M + S) + 8:  # 2(M+S-1) expected; anything near 4x is a bug
+            raise RuntimeError(f"1F1B schedule failed to converge (S={S}, M={M})")
+        # decide every device against PRE-tick state, then commit
+        decisions = []
+        for i in range(S):
+            if pos[i] >= len(seqs[i]):
+                decisions.append(None)
+                continue
+            act, m = seqs[i][pos[i]]
+            if act == "F":
+                ready = i == 0 or 0 <= fdone[i - 1][m] < t
+            elif i == S - 1:
+                ready = 0 <= fdone[i][m] < t  # loss cotangent is local
+            else:
+                ready = 0 <= bdone[i + 1][m] < t
+            decisions.append((act, m) if ready else None)
+        rf, rb = [False] * S, [False] * S
+        rmf, rmb = [0] * S, [0] * S
+        for i, d in enumerate(decisions):
+            if d is None:
+                continue
+            act, m = d
+            if act == "F":
+                fdone[i][m] = t
+                rf[i], rmf[i] = True, m
+            else:
+                bdone[i][m] = t
+                rb[i], rmb[i] = True, m
+            pos[i] += 1
+        rows_f.append(rf)
+        rows_b.append(rb)
+        rows_mf.append(rmf)
+        rows_mb.append(rmb)
+        t += 1
+
+    # ---- safety proofs for the runtime's fixed-size buffers ----
+    for i in range(S - 1):  # activation latch on edge i -> i+1
+        for m in range(M):
+            assert fdone[i][m] < fdone[i + 1][m], (i, m, "act order")
+            if m + 1 < M:
+                assert fdone[i][m + 1] >= fdone[i + 1][m], (
+                    i, m, "act latch overwritten before consumption")
+    for i in range(S - 1):  # cotangent latch on edge i+1 -> i
+        for m in range(M):
+            assert bdone[i + 1][m] < bdone[i][m], (i, m, "cot order")
+            if m + 1 < M:
+                assert bdone[i + 1][m + 1] >= bdone[i][m], (
+                    i, m, "cot latch overwritten before consumption")
+    ring = min(S, M)
+    for i in range(S):  # ring-slot reuse
+        for m in range(M - ring):
+            assert fdone[i][m + ring] > bdone[i][m], (
+                i, m, "ring slot reused while occupant still in flight")
+
+    is_fwd = np.asarray(rows_f, dtype=bool)
+    is_bwd = np.asarray(rows_b, dtype=bool)
+    fwd_mb = np.asarray(rows_mf, dtype=np.int32)
+    bwd_mb = np.asarray(rows_mb, dtype=np.int32)
+    left_fwd = np.zeros_like(is_fwd)
+    left_fwd[:, 1:] = is_fwd[:, :-1]
+    right_bwd = np.zeros_like(is_bwd)
+    right_bwd[:, :-1] = is_bwd[:, 1:]
+    return Schedule1F1B(
+        is_fwd, is_bwd, fwd_mb, bwd_mb,
+        (fwd_mb % ring).astype(np.int32), (bwd_mb % ring).astype(np.int32),
+        left_fwd, right_bwd,
+    )
+
+
+def pipeline_grads_1f1b(
+    stage_fn: Callable,
+    embed_fn: Callable,
+    head_fn: Callable,
+    mesh: Mesh,
+    axis: str = PIPE_AXIS,
+    num_microbatches: Optional[int] = None,
+    batch_axis: Optional[str] = None,
+):
+    """Build ``run(stacked_params, outer, inputs, labels) -> (loss,
+    stage_grads, outer_grads)`` executing the full fwd+bwd 1F1B schedule.
+
+    * ``stage_fn(stage_params, x) -> y`` — shape-preserving pipe stage
+      (``switch_stage``'s three-argument heterogeneous form and
+      ``chunk_stages``-blocked virtual stages both compose);
+    * ``embed_fn(outer, inputs_mb) -> x0`` — stage-0 entry (e.g. token
+      embedding), re-run under ``vjp`` at backward ticks;
+    * ``head_fn(outer, y, labels_mb) -> scalar`` — stage-(S-1) exit:
+      per-microbatch mean loss.  The pipeline's loss is the mean over
+      microbatches; gradients match ``jax.grad`` of that composition
+      (tests/test_pp_1f1b.py proves it against the unpipelined model).
+
+    ``stage_grads`` come back stage-stacked (leading dim sharded on
+    ``axis``) exactly like the input params — the optimizer update stays
+    local to each pipe device.  ``outer_grads`` are psum'd across the
+    pipe axis (embedding contributions from device 0, head contributions
+    from device S-1; tied weights sum correctly).  ``batch_axis``
+    composes data parallelism on a ``(data, pipe)`` mesh: grads are
+    additionally averaged over ``batch_axis`` so each data row sees the
+    global mean, matching the framework's DP semantics.
+    """
+    S = mesh.shape[axis]
+    M = num_microbatches or S
+    sched = build_schedule(S, M)
+    ring = min(S, M)
+    with_stage = _accepts_stage(stage_fn)
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+    rows = tuple(
+        jnp.asarray(a) for a in (
+            sched.is_fwd, sched.is_bwd, sched.fwd_mb, sched.bwd_mb,
+            sched.fwd_slot, sched.bwd_slot, sched.left_fwd, sched.right_bwd,
+        )
+    )
+
+    def apply_stage(sp, x, idx):
+        return stage_fn(sp, x, idx) if with_stage else stage_fn(sp, x)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P(batch_axis), P(batch_axis)),
+        out_specs=(P(), P(axis), P()),
+    )
+    def run(stacked_params, outer, inputs, labels):
+        sp = jax.tree.map(lambda p: p[0], stacked_params)
+        idx = jax.lax.axis_index(axis)
+        b = inputs.shape[0]
+        assert b % M == 0, f"batch {b} not divisible by {M} microbatches"
+        mb_in = inputs.reshape(M, b // M, *inputs.shape[1:])
+        mb_lab = labels.reshape(M, b // M, *labels.shape[1:])
+
+        want_axes = (axis,) if batch_axis is None else (axis, batch_axis)
+
+        def _leaf_varying(x):
+            # pcast rejects an already-varying operand; consult the
+            # aval's varying-manual-axes set and convert only fresh
+            # constants (zeros_like of a varying leaf is varying itself).
+            # Under a (data, pipe) mesh the buffers must be varying over
+            # BOTH axes, or cond branches mixing batch-derived values
+            # with carries fail VMA typing.
+            for ax in want_axes:
+                if ax not in getattr(jax.typeof(x), "vma", frozenset()):
+                    x = jax.lax.pcast(x, ax, to="varying")
+            return x
+
+        varying = lambda tr: jax.tree.map(_leaf_varying, tr)
+        act = jax.eval_shape(embed_fn, outer, mb_in[0])
+        # Use fully-VARYING views of the param trees inside the ticks:
+        # differentiating w.r.t. a tree that is invariant over any mesh
+        # axis makes the vjp transpose insert a psum_invariant INSIDE
+        # the cond branch — a collective only some devices execute,
+        # which deadlocks the mesh.  With varying params the pullback
+        # stays device-local and the psums after the scan combine the
+        # contributions (pipe for outer, batch_axis for both).
+        outer = varying(outer)
+        sp = varying(sp)
+        zero_act = varying(jnp.zeros(act.shape, act.dtype))
+        zeros_sp = varying(jax.tree.map(jnp.zeros_like, sp))
+        zeros_outer = varying(jax.tree.map(jnp.zeros_like, outer))
+        f32_0 = varying(jnp.float32(0.0))
+        # d(mean over microbatches)/d(l_m); varying like the vjp output
+        seed = varying(jnp.float32(1.0 / M))
+
+        def tick(carry, row):
+            h_act, h_cot, ringbuf, g_sp, g_out, loss_acc = carry
+            isf, isb, mfs, mbs, sfs, sbs, lfs, rbs = row
+            f = jnp.take(isf, idx)
+            bk = jnp.take(isb, idx)
+            mf, mb_ = jnp.take(mfs, idx), jnp.take(mbs, idx)
+            sf, sb = jnp.take(sfs, idx), jnp.take(sbs, idx)
+
+            # ---- forward tick: (maybe embed) -> stage -> stash input
+            def do_f(_):
+                x_in = jax.lax.cond(
+                    idx == 0,
+                    lambda _: _leaf_varying(
+                        embed_fn(outer, jax.lax.dynamic_index_in_dim(
+                            mb_in, mf, 0, keepdims=False))),
+                    lambda _: h_act,
+                    None,
+                )
+                y = apply_stage(sp, x_in, idx)
+                return y, jax.lax.dynamic_update_index_in_dim(
+                    ringbuf, x_in, sf, 0)
+
+            y_send, ringbuf = jax.lax.cond(
+                f, do_f, lambda _: (zero_act, ringbuf), None)
+
+            # ---- backward tick: recompute fwd under vjp from the
+            # stashed input, pull the cotangent through
+            def do_b(_):
+                x_saved = jax.lax.dynamic_index_in_dim(
+                    ringbuf, sb, 0, keepdims=False)
+                lab = jax.lax.dynamic_index_in_dim(
+                    mb_lab, mb_, 0, keepdims=False)
+
+                def last(_):
+                    def fn(sp_, out_, x_):
+                        return head_fn(out_, apply_stage(sp_, x_, idx), lab)
+
+                    l, pull = jax.vjp(fn, sp, outer, x_saved)
+                    gs, go, gx = pull(seed)
+                    return gs, varying(go), gx, l
+
+                def inner(_):
+                    y, pull = jax.vjp(
+                        lambda sp_, x_: apply_stage(sp_, x_, idx), sp, x_saved)
+                    gs, gx = pull(h_cot)
+                    return gs, zeros_outer, gx, f32_0
+
+                gs, go, gx, l = jax.lax.cond(idx == S - 1, last, inner, None)
+
+                def embed_bwd(_):
+                    tok = jax.lax.dynamic_index_in_dim(
+                        mb_in, mb_, 0, keepdims=False)
+                    _, pull = jax.vjp(lambda o: embed_fn(o, tok), outer)
+                    (go0,) = pull(gx)
+                    return jax.tree.map(jnp.add, go, go0)
+
+                go = jax.lax.cond(idx == 0, embed_bwd, lambda _: go, None)
+                return gs, go, gx, l
+
+            gs_d, go_d, gx_send, l = jax.lax.cond(
+                bk, do_b,
+                lambda _: (zeros_sp, zeros_outer, zero_act, f32_0), None)
+            g_sp = jax.tree.map(jnp.add, g_sp, gs_d)
+            g_out = jax.tree.map(jnp.add, g_out, go_d)
+            loss_acc = loss_acc + l
+
+            # ---- neighbor transfers + latches (collectives stay
+            # OUTSIDE every cond: all devices participate every tick).
+            # The barrier serializes the two transfers: XLA gives every
+            # manual-mode collective the same channel id, and the CPU
+            # thunk executor runs independent collectives concurrently,
+            # so without a data dependency the two permutes join each
+            # other's rendezvous and deadlock.  Sequential same-channel
+            # collectives are safe (each epoch is a full barrier — the
+            # same property every scan-over-ppermute pipeline relies on).
+            recv_a = jax.lax.ppermute(y_send, axis, fwd_perm)
+            gx_send = jax.lax.optimization_barrier((gx_send, recv_a))[0]
+            recv_c = jax.lax.ppermute(gx_send, axis, bwd_perm)
+            h_act = jnp.where(jnp.take(lfs, idx), recv_a, h_act)
+            h_cot = jnp.where(jnp.take(rbs, idx), recv_c, h_cot)
+            return (h_act, h_cot, ringbuf, g_sp, g_out, loss_acc), None
+
+        ringbuf0 = varying(
+            jnp.zeros((ring,) + act.shape, act.dtype))
+        carry0 = (zero_act, zero_act, ringbuf0, zeros_sp, zeros_outer, f32_0)
+        (_, _, _, g_sp, g_out, loss_acc), _ = jax.lax.scan(tick, carry0, rows)
+
+        loss = jax.lax.psum(loss_acc, axis) / M
+        g_out = jax.lax.psum(g_out, axis)
+        if batch_axis is not None:  # DP composition: mean over data rows
+            n = mesh.shape[batch_axis]
+            loss = jax.lax.psum(loss, batch_axis) / n
+            g_out = jax.tree.map(
+                lambda g: jax.lax.psum(g, batch_axis) / n, g_out)
+            g_sp = jax.tree.map(
+                lambda g: jax.lax.psum(g, batch_axis) / n, g_sp)
+        return loss, jax.tree.map(lambda g: g[None], g_sp), g_out
+
+    run.schedule = sched
+    run.utilization = 2 * M / sched.ticks
+    return run
+
+
+def make_train_step_1f1b(
+    stage_fn: Callable,
+    embed_fn: Callable,
+    head_fn: Callable,
+    optimizer: Optimizer,
+    mesh: Mesh,
+    axis: str = PIPE_AXIS,
+    num_microbatches: Optional[int] = None,
+    batch_axis: Optional[str] = None,
+    donate: bool = True,
+    input_key: str = "tokens",
+    label_key: Optional[str] = None,
+):
+    """Compile a full 1F1B training step.
+
+    ``TrainState.params`` is the split tree ``{"outer": ..., "stages":
+    ...}`` (``lm_pp_1f1b``'s ``split_params`` builds it for the LM).
+    Gradients never leave their pipe device except the psum'd outer
+    tree, so the optimizer update is stage-local like the GPipe step
+    (``pp.make_train_step_pp``).  ``label_key`` defaults to
+    ``input_key`` (next-token LM losses read the shifted inputs).
+    """
+    from ..sharding import make_shardings
+    from .tp import state_specs
+
+    run = pipeline_grads_1f1b(
+        stage_fn, embed_fn, head_fn, mesh, axis=axis,
+        num_microbatches=num_microbatches, batch_axis=batch_axis,
+    )
+    repl = NamedSharding(mesh, P())
+
+    def state_shardings(state: TrainState) -> TrainState:
+        p_specs = {
+            "outer": jax.tree.map(lambda _: P(), state.params["outer"]),
+            "stages": jax.tree.map(lambda _: P(axis), state.params["stages"]),
+        }
+        return make_shardings(state_specs(state, p_specs), mesh)
+
+    def step(state: TrainState, batch):
+        loss, g_stages, g_outer = run(
+            state.params["stages"], state.params["outer"],
+            batch[input_key], batch[label_key or input_key],
+        )
+        grads = {"outer": g_outer, "stages": g_stages}
+        new_params, new_opt = optimizer.apply(
+            state.params, grads, state.opt_state, state.step
+        )
+        return TrainState(
+            params=new_params, opt_state=new_opt,
+            model_state=state.model_state, step=state.step + 1,
+        ), {"loss": loss}
+
+    def compile_for(state: TrainState):
+        sh = state_shardings(state)
+        return jax.jit(
+            step,
+            in_shardings=(sh, repl),
+            out_shardings=(sh, repl),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    return compile_for
