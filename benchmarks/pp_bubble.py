@@ -1,18 +1,25 @@
 #!/usr/bin/env python
-"""Measured GPipe bubble vs the (S-1)/(M+S-1) formula.
+"""Measured pipeline-schedule scaling vs the (S-1)/(M+S-1) formula,
+GPipe (AD-derived backward) vs hand-scheduled 1F1B.
 
-The pipeline schedule (parallel/pp.py:26-28) predicts utilization
-M/(M+S-1) for M microbatches over S stages: throughput at M should scale
-as that factor relative to the bubble-free limit.  This script times the
-pipelined LM forward+backward at M in {S, 2S, 4S, 8S} and fits the
-observed scaling against the formula, reporting where GPipe's bubble
-stops being acceptable (VERDICT r3 weak #6).
+The GPipe schedule (parallel/pp.py:26-28) predicts utilization
+M/(M+S-1) for M microbatches over S stages.  This script times the
+pipelined LM forward+backward at M in {S, 2S, 4S, 8S} for either
+schedule (``--schedule gpipe|1f1b``) and reports per-microbatch cost
+scaling (VERDICT r3 weak #6).
 
-On the 8-virtual-CPU mesh the per-tick cost is compute-dominated, so the
-measured ratios validate the SCHEDULE (tick count) — ICI transfer
-overlap needs a real multi-chip slice; on one, run with the same flags.
+What each substrate can show:
+
+* a real multi-chip slice measures the BUBBLE itself (idle devices);
+* the shared-core fake-device mesh cannot (devices are never idle),
+  but it exposes the schedules' MEMORY behavior: GPipe's AD-through-
+  scan stores residuals for all M microbatches, so per-tick cost
+  inflates with M (cache/allocator pressure), while 1F1B's fixed
+  min(S,M)-slot input ring keeps per-microbatch cost ~flat — that
+  contrast is the point of the comparison here.
 
     python benchmarks/pp_bubble.py --platform cpu --dim 128 --depth 8
+    python benchmarks/pp_bubble.py --platform cpu --schedule 1f1b
 """
 
 from __future__ import annotations
@@ -41,6 +48,7 @@ def main():
                     help="sequences per microbatch (fixed; M scales total batch)")
     ap.add_argument("--vocab", type=int, default=512)
     ap.add_argument("--seconds", type=float, default=2.0)
+    ap.add_argument("--schedule", choices=("gpipe", "1f1b"), default="gpipe")
     args = ap.parse_args()
 
     import jax
@@ -52,7 +60,9 @@ def main():
     import jax.numpy as jnp
 
     from fluxdistributed_tpu import mesh as mesh_lib
-    from fluxdistributed_tpu.models.transformer_lm import TransformerLM, lm_pp
+    from fluxdistributed_tpu.models.transformer_lm import (
+        TransformerLM, lm_pp, lm_pp_1f1b,
+    )
 
     S = jax.device_count()
     mesh = mesh_lib.make_mesh({"pipe": S})
@@ -71,24 +81,38 @@ def main():
         M = S * mult
         batch = args.mb_size * M
         toks = rng.integers(0, args.vocab, (batch, args.seqlen)).astype(np.int32)
-        split_params, loss_fn, _ = lm_pp(model, mesh, num_microbatches=M)
-        pp = split_params(params)
+        if args.schedule == "1f1b":
+            from fluxdistributed_tpu.parallel.pp_1f1b import pipeline_grads_1f1b
 
-        @jax.jit
-        def fwdbwd(p, t):
-            # loss on the pipelined forward; grads run the reverse schedule
-            def loss(pp_):
-                l, _aux = loss_fn(pp_, {}, {"tokens": t}, False)
-                return l
+            split_params, (stage_fn, embed_fn, head_fn), _ = lm_pp_1f1b(model, mesh)
+            pp = split_params(params)
+            run = pipeline_grads_1f1b(
+                stage_fn, embed_fn, head_fn, mesh, num_microbatches=M)
 
-            return jax.value_and_grad(loss)(p)
+            @jax.jit
+            def fwdbwd(p, t):
+                # the 1F1B program IS fwd+bwd: loss and both grad trees
+                return run(p["stages"], p["outer"], t, t)
 
-        l, g = fwdbwd(pp, toks)
+        else:
+            split_params, loss_fn, _ = lm_pp(model, mesh, num_microbatches=M)
+            pp = split_params(params)
+
+            @jax.jit
+            def fwdbwd(p, t):
+                # loss on the pipelined forward; grads run the reverse schedule
+                def loss(pp_):
+                    l, _aux = loss_fn(pp_, {}, {"tokens": t}, False)
+                    return l
+
+                return jax.value_and_grad(loss)(p)
+
+        l, *g = fwdbwd(pp, toks)
         jax.block_until_ready(l)
         t0 = time.perf_counter()
         iters = 0
         while time.perf_counter() - t0 < args.seconds:
-            l, g = fwdbwd(pp, toks)
+            l, *g = fwdbwd(pp, toks)
             iters += 1
         jax.block_until_ready(l)
         dt = (time.perf_counter() - t0) / iters
@@ -108,7 +132,7 @@ def main():
         print(json.dumps(rows[-1]), flush=True)
 
     print(json.dumps({
-        "metric": "GPipe bubble: measured vs (S-1)/(M+S-1)",
+        "metric": f"{args.schedule} pipeline: measured vs (S-1)/(M+S-1)",
         "platform": jax.devices()[0].platform,
         "rows": rows,
     }))

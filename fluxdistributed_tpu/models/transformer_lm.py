@@ -563,21 +563,12 @@ def _pp_split_params(model: "TransformerLM", mesh, pipe_axis: str, S: int, V: in
 
 
 def _pp_state_shardings(mesh, pipe_axis: str):
-    """Shared TrainState sharding builder for the split tree: outer
-    replicated, stages pipe-sharded, optimizer state following."""
-    from jax.sharding import PartitionSpec as P
+    """Shared TrainState sharding builder for the split tree — the
+    single implementation lives with the schedule that compiles against
+    it (``parallel.pp_1f1b.split_state_shardings``)."""
+    from ..parallel.pp_1f1b import split_state_shardings
 
-    from ..parallel.tp import state_specs
-    from ..sharding import make_shardings
-
-    def state_shardings(state):
-        p_specs = {
-            "outer": jax.tree.map(lambda _: P(), state.params["outer"]),
-            "stages": jax.tree.map(lambda _: P(pipe_axis), state.params["stages"]),
-        }
-        return make_shardings(state_specs(state, p_specs), mesh)
-
-    return state_shardings
+    return split_state_shardings(mesh, pipe_axis)
 
 
 def lm_pp(

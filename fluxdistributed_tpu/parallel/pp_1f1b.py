@@ -62,7 +62,26 @@ from .pp import PIPE_AXIS, _accepts_stage
 Pytree = Any
 
 __all__ = ["Schedule1F1B", "build_schedule", "pipeline_grads_1f1b",
-           "make_train_step_1f1b"]
+           "make_train_step_1f1b", "split_state_shardings"]
+
+
+def split_state_shardings(mesh: Mesh, axis: str = PIPE_AXIS) -> Callable:
+    """``state_shardings(state)`` builder for the split param tree
+    ``{"outer": ..., "stages": ...}``: outer replicated, stages sharded
+    on ``axis``, optimizer state following its param.  The single source
+    of truth for both pipeline schedules (``lm_pp``/``lm_pp_1f1b`` reuse
+    it, and ``make_train_step_1f1b`` compiles with it)."""
+    from ..sharding import make_shardings
+    from .tp import state_specs
+
+    def state_shardings(state: TrainState) -> TrainState:
+        p_specs = {
+            "outer": jax.tree.map(lambda _: P(), state.params["outer"]),
+            "stages": jax.tree.map(lambda _: P(axis), state.params["stages"]),
+        }
+        return make_shardings(state_specs(state, p_specs), mesh)
+
+    return state_shardings
 
 
 class Schedule1F1B(NamedTuple):
@@ -173,24 +192,33 @@ def build_schedule(S: int, M: int) -> Schedule1F1B:
         rows_mb.append(rmb)
         t += 1
 
-    # ---- safety proofs for the runtime's fixed-size buffers ----
+    # ---- safety proofs for the runtime's fixed-size buffers.  Real
+    # exceptions, not asserts: a placement bug here means silently
+    # corrupted gradients at runtime, and asserts vanish under -O.
+    def _prove(ok: bool, i: int, m: int, what: str):
+        if not ok:
+            raise RuntimeError(
+                f"1F1B schedule unsafe for S={S}, M={M}: {what} "
+                f"(device {i}, microbatch {m})"
+            )
+
     for i in range(S - 1):  # activation latch on edge i -> i+1
         for m in range(M):
-            assert fdone[i][m] < fdone[i + 1][m], (i, m, "act order")
+            _prove(fdone[i][m] < fdone[i + 1][m], i, m, "act order")
             if m + 1 < M:
-                assert fdone[i][m + 1] >= fdone[i + 1][m], (
-                    i, m, "act latch overwritten before consumption")
+                _prove(fdone[i][m + 1] >= fdone[i + 1][m], i, m,
+                       "act latch overwritten before consumption")
     for i in range(S - 1):  # cotangent latch on edge i+1 -> i
         for m in range(M):
-            assert bdone[i + 1][m] < bdone[i][m], (i, m, "cot order")
+            _prove(bdone[i + 1][m] < bdone[i][m], i, m, "cot order")
             if m + 1 < M:
-                assert bdone[i + 1][m + 1] >= bdone[i][m], (
-                    i, m, "cot latch overwritten before consumption")
+                _prove(bdone[i + 1][m + 1] >= bdone[i][m], i, m,
+                       "cot latch overwritten before consumption")
     ring = min(S, M)
     for i in range(S):  # ring-slot reuse
         for m in range(M - ring):
-            assert fdone[i][m + ring] > bdone[i][m], (
-                i, m, "ring slot reused while occupant still in flight")
+            _prove(fdone[i][m + ring] > bdone[i][m], i, m,
+                   "ring slot reused while occupant still in flight")
 
     is_fwd = np.asarray(rows_f, dtype=bool)
     is_bwd = np.asarray(rows_b, dtype=bool)
@@ -426,21 +454,12 @@ def make_train_step_1f1b(
     (``pp.make_train_step_pp``).  ``label_key`` defaults to
     ``input_key`` (next-token LM losses read the shifted inputs).
     """
-    from ..sharding import make_shardings
-    from .tp import state_specs
-
     run = pipeline_grads_1f1b(
         stage_fn, embed_fn, head_fn, mesh, axis=axis,
         num_microbatches=num_microbatches, batch_axis=batch_axis,
     )
     repl = NamedSharding(mesh, P())
-
-    def state_shardings(state: TrainState) -> TrainState:
-        p_specs = {
-            "outer": jax.tree.map(lambda _: P(), state.params["outer"]),
-            "stages": jax.tree.map(lambda _: P(axis), state.params["stages"]),
-        }
-        return make_shardings(state_specs(state, p_specs), mesh)
+    state_shardings = split_state_shardings(mesh, axis)
 
     def step(state: TrainState, batch):
         loss, g_stages, g_outer = run(
