@@ -84,10 +84,10 @@ def main():
         if args.schedule == "1f1b":
             from fluxdistributed_tpu.parallel.pp_1f1b import pipeline_grads_1f1b
 
-            split_params, (stage_fn, embed_fn, head_fn), _ = lm_pp_1f1b(model, mesh)
-            pp = split_params(params)
+            w = lm_pp_1f1b(model, mesh)
+            pp = w.split_params(params)
             run = pipeline_grads_1f1b(
-                stage_fn, embed_fn, head_fn, mesh, num_microbatches=M)
+                *w.fns, mesh, num_microbatches=M, interleave=w.interleave)
 
             @jax.jit
             def fwdbwd(p, t):

@@ -27,7 +27,7 @@ next-token cross-entropy.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Optional
+from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -501,11 +501,15 @@ def generate(
     return out
 
 
-def _pp_validate_and_stage(model: "TransformerLM", mesh, pipe_axis: str, what: str):
+def _pp_validate_and_stage(model: "TransformerLM", mesh, pipe_axis: str, what: str,
+                           blocked: bool = True):
     """Shared lm_pp/lm_pp_1f1b front half: validate the model is
     pipelineable, and build the per-stage callable.  Returns
-    ``(S, V, stage_fn)`` — V logical blocks hosted per pipe device,
-    ``stage_fn`` already ``chunk_stages``-blocked when V > 1."""
+    ``(S, V, stage_fn)`` — V logical blocks hosted per pipe device.
+    ``blocked=True`` wraps V > 1 into one ``chunk_stages`` scan per tick
+    (GPipe / plain 1F1B); ``blocked=False`` returns the single-block
+    callable for the interleaved 1F1B schedule, which applies one
+    logical block per tick itself."""
     from ..parallel.pp import chunk_stages
 
     if not model.use_rope:
@@ -536,23 +540,34 @@ def _pp_validate_and_stage(model: "TransformerLM", mesh, pipe_axis: str, what: s
     def base_fn(p, x):
         return blk.apply({"params": p}, x, train=False)
 
-    return S, V, (base_fn if V == 1 else chunk_stages(base_fn))
+    return S, V, (base_fn if V == 1 or not blocked else chunk_stages(base_fn))
 
 
-def _pp_split_params(model: "TransformerLM", mesh, pipe_axis: str, S: int, V: int):
+def _pp_split_params(model: "TransformerLM", mesh, pipe_axis: str, S: int, V: int,
+                     placement: str = "blocked"):
     """Shared splitter: full param tree -> ``{"outer", "stages"}`` with
-    block trees stacked (chunked ``(S, V, ...)`` when V > 1) on a
-    leading dim sharded over ``pipe_axis``.  Both pipeline schedules use
-    this same tree, so their checkpoints/shardings are interchangeable."""
+    block trees stacked (``(S, V, ...)`` when V > 1) on a leading dim
+    sharded over ``pipe_axis``.
+
+    ``placement`` fixes which logical block lands at ``[device, chunk]``:
+    ``"blocked"`` (device s hosts consecutive blocks ``s·V … s·V+V-1`` —
+    the ``chunk_stages`` layout both GPipe and plain 1F1B scan over) or
+    ``"interleaved"`` (device i's chunk c hosts block ``c·S + i`` — the
+    round-robin layout ``pipeline_grads_1f1b(interleave=V)`` schedules).
+    Within one placement the two schedules share the tree, so their
+    checkpoints/shardings are interchangeable."""
     from ..parallel.pp import stack_stage_params
 
     def split_params(params):
         stages = [params[f"block{i}"] for i in range(model.depth)]
         outer = {k: v for k, v in params.items() if not k.startswith("block")}
         if V > 1:
+            if placement == "interleaved":
+                groups = [[stages[c * S + s] for c in range(V)] for s in range(S)]
+            else:
+                groups = [stages[s * V : (s + 1) * V] for s in range(S)]
             stages = [
-                jax.tree.map(lambda *xs: jnp.stack(xs), *stages[s * V : (s + 1) * V])
-                for s in range(S)
+                jax.tree.map(lambda *xs: jnp.stack(xs), *g) for g in groups
             ]
         return {
             "outer": outer,
@@ -633,10 +648,29 @@ def lm_pp(
     return split_params, loss_fn, _pp_state_shardings(mesh, pipe_axis)
 
 
+class LMPipelineWiring(NamedTuple):
+    """Everything ``parallel.pp_1f1b.make_train_step_1f1b`` needs, with
+    the interleave factor attached so callers never recompute
+    ``depth // S`` by hand (``interleave`` is 1 for blocked placement,
+    where the V surplus blocks ride inside ``chunk_stages``)::
+
+        w = lm_pp_1f1b(model, mesh, interleave=True)
+        step = make_train_step_1f1b(*w.fns, opt, mesh,
+                                    interleave=w.interleave, ...)(state)
+        state = TrainState.create(w.split_params(params), opt)
+    """
+
+    split_params: Callable
+    fns: tuple  # (stage_fn, embed_fn, head_fn)
+    state_shardings: Callable
+    interleave: int = 1
+
+
 def lm_pp_1f1b(
     model: TransformerLM,
     mesh,
     pipe_axis: str = "pipe",
+    interleave: bool = False,
 ):
     """Pipeline-parallelize the LM on the hand-scheduled 1F1B schedule
     (``parallel.pp_1f1b``) instead of GPipe-via-AD (``lm_pp``).
@@ -647,20 +681,28 @@ def lm_pp_1f1b(
     device instead of O(M·ticks) scan residuals, so the microbatch
     count (and with it the bubble (S-1)/(M+S-1)) can grow freely.
 
+    ``interleave=True`` switches the V = depth/S surplus blocks from the
+    blocked ``chunk_stages`` layout to the Megatron interleaved
+    placement (device i hosts blocks ``c·S + i``): the fill/drain
+    bubble shrinks ~V-fold.  NOTE the param layouts differ (round-robin
+    vs consecutive), so blocked and interleaved split trees are NOT
+    interchangeable.
+
     Because 1F1B interleaves forwards and backwards, the embedding and
     the final-norm/logits/loss run INSIDE the schedule, per microbatch,
     on pipe devices 0 and S-1; their ("outer") grads are psum'd across
     the pipe axis, which also makes tied embeddings sum correctly.
 
-    Returns ``(split_params, fns, state_shardings)`` where ``fns`` is
-    the ``(stage_fn, embed_fn, head_fn)`` triple for
-    ``parallel.pp_1f1b.make_train_step_1f1b`` — pass ``num_microbatches``
-    and ``batch_axis`` THERE (they parameterize the schedule, not the
-    stage decomposition).  Constraints are ``lm_pp``'s (rope, no
-    dropout, no MoE) plus: no ``batch["mask"]`` support (the
-    per-microbatch loss reads tokens only).
+    Returns an ``LMPipelineWiring`` — feed ``w.fns`` and
+    ``w.interleave`` to ``parallel.pp_1f1b.make_train_step_1f1b``
+    (``num_microbatches`` and ``batch_axis`` also go THERE: they
+    parameterize the schedule, not the stage decomposition).
+    Constraints are ``lm_pp``'s (rope, no dropout, no MoE) plus: no
+    ``batch["mask"]`` support (the per-microbatch loss reads tokens
+    only).
     """
-    S, V, stage_fn = _pp_validate_and_stage(model, mesh, pipe_axis, "lm_pp_1f1b")
+    S, V, stage_fn = _pp_validate_and_stage(
+        model, mesh, pipe_axis, "lm_pp_1f1b", blocked=not interleave)
     embed = nn.Embed(model.vocab, model.dim, dtype=model.dtype)
     ln = nn.LayerNorm(dtype=model.dtype)
 
@@ -677,10 +719,12 @@ def lm_pp_1f1b(
             )
         return next_token_loss(jnp.asarray(logits, jnp.float32), tokens_mb)
 
-    return (
-        _pp_split_params(model, mesh, pipe_axis, S, V),
+    return LMPipelineWiring(
+        _pp_split_params(model, mesh, pipe_axis, S, V,
+                         placement="interleaved" if interleave else "blocked"),
         (stage_fn, embed_fn, head_fn),
         _pp_state_shardings(mesh, pipe_axis),
+        V if interleave else 1,
     )
 
 

@@ -90,149 +90,302 @@ class Schedule1F1B(NamedTuple):
     ``is_fwd[t, i]``/``is_bwd[t, i]`` — does device i run a stage
     forward / backward at tick t (at most one of the two is set);
     ``fwd_mb``/``bwd_mb`` — which microbatch (0 when inactive);
-    ``fwd_slot``/``bwd_slot`` — its ring-buffer slot (mb mod ring);
-    ``left_fwd[t, i]`` = is_fwd[t, i-1]: the left neighbor produced an
-    activation this tick, so latch the incoming ppermute value;
-    ``right_bwd[t, i]`` = is_bwd[t, i+1]: same for cotangents.
+    ``fwd_chunk``/``bwd_chunk`` — which of the device's V interleaved
+    chunks (always 0 when V = 1): selects the chunk's params and its
+    input-ring slab;
+    ``fwd_slot``/``bwd_slot`` — the ring-buffer slot inside that chunk;
+    ``fwd_latch``/``bwd_latch`` — FLAT index (``chunk·D + mb mod D``)
+    into the depth-D latch buffers a consuming tick reads from;
+    ``recv_act[t, i]`` — the neighbor to the left (ring order) produced
+    an activation this tick, so latch the incoming ppermute value at
+    flat index ``recv_act_ix[t, i]``; ``recv_cot``/``recv_cot_ix`` —
+    same for cotangents from the right.
+
+    ``ring`` — input-ring slots per chunk; ``n_chunks`` — V;
+    ``latch_depth`` — D latch slots per chunk per direction.
     """
 
     is_fwd: np.ndarray
     is_bwd: np.ndarray
     fwd_mb: np.ndarray
     bwd_mb: np.ndarray
+    fwd_chunk: np.ndarray
+    bwd_chunk: np.ndarray
     fwd_slot: np.ndarray
     bwd_slot: np.ndarray
-    left_fwd: np.ndarray
-    right_bwd: np.ndarray
+    fwd_latch: np.ndarray
+    bwd_latch: np.ndarray
+    recv_act: np.ndarray
+    recv_act_ix: np.ndarray
+    recv_cot: np.ndarray
+    recv_cot_ix: np.ndarray
+    ring: int
+    n_chunks: int
+    latch_depth: int
 
     @property
     def ticks(self) -> int:
         return self.is_fwd.shape[0]
 
+    @property
+    def utilization(self) -> float:
+        """Busy fraction: each device performs 2·V·M actions over the
+        T ticks (identical per device; device 0's count is used)."""
+        busy = int(self.is_fwd[:, 0].sum() + self.is_bwd[:, 0].sum())
+        return busy / self.ticks
 
-def build_schedule(S: int, M: int) -> Schedule1F1B:
-    """Build and VERIFY the lockstep 1F1B timetable for S stages and M
-    microbatches.
 
-    Per-device action order is the classic warmup/steady/cooldown
-    sequence — device i runs ``W = min(S-1-i, M)`` warmup forwards, then
-    alternates forward/backward until forwards run out, then drains
-    backwards.  Actions are placed onto lockstep ticks greedily, each
-    device firing its next action as soon as its dependency (upstream
-    forward / downstream backward, strictly earlier tick) is met.
+def build_schedule(S: int, M: int, V: int = 1) -> Schedule1F1B:
+    """Build and VERIFY the lockstep 1F1B timetable for S pipe devices,
+    M microbatches, and V interleaved chunks per device (virtual
+    stages; logical stage ``c·S + i`` lives on device i as chunk c).
 
-    The builder then PROVES the placement safe for the runtime's
-    fixed-size buffers, asserting for every edge and every slot:
+    Placement is dependency-driven lockstep greedy list-scheduling.
+    Because no single greedy discipline wins across (S, M, V) — the
+    1F1B backward-first rule is best for V ≤ 2, forward-first (memory
+    gates throttling) often wins at deeper interleave — the builder
+    tries a small PORTFOLIO (backward-first / forward-first × latch
+    depth D ∈ {1, 2}) and keeps the timetable with the fewest ticks.
+    Readiness = upstream forward / downstream cotangent placed at a
+    strictly earlier tick, plus two resource gates that bound the
+    runtime's buffers: the per-chunk input-ring slot gate (in-flight ≤
+    min(S, M) per chunk) and the depth-D latch gate (a producer may not
+    send value m until its consumer consumed m−D).
 
-    * single-latch safety: a produced activation/cotangent is consumed
-      before (or exactly when) the producer's next value lands;
-    * ring safety: a stored input's slot is not reused until its own
-      backward has retired it.
+    For V = 1 the backward-first/D=1 member reproduces the classic
+    warmup/steady/cooldown sequence and the canonical 2(M+S-1) ticks;
+    for V > 1 the fill/drain bubble shrinks toward (S-1)/V chunk-ticks
+    per side — the Megatron interleaving effect (the returned
+    ``utilization`` property reports the achieved busy fraction).
 
-    Greedy lockstep placement lands on the canonical 2(M+S-1) ticks
-    (bubble fraction (S-1)/(M+S-1), same as GPipe — 1F1B's win is
-    memory, not bubble).
+    The builder then PROVES the chosen placement safe for the runtime's
+    fixed-size buffers, raising for every edge/chunk and every slot on:
+    latch safety (a produced activation/cotangent is consumed before
+    the producer's D-th next value for that chunk lands) and ring
+    safety (a stored input's slot is not reused until its own backward
+    retires it).
     """
     if S < 2:
         raise ValueError(f"1F1B needs >= 2 pipeline stages, got {S}")
     if M < 1:
         raise ValueError(f"need >= 1 microbatch, got {M}")
+    if V < 1:
+        raise ValueError(f"need >= 1 chunk per device, got {V}")
 
-    # per-device action sequences: [F]*W + [F,B]*(M-W) + [B]*W
-    seqs = []
-    for i in range(S):
-        w = min(S - 1 - i, M)
-        seq = [("F", m) for m in range(w)]
-        nxt = w
-        for m in range(M - w):
-            seq.append(("F", nxt))
-            nxt += 1
-            seq.append(("B", m))
-        seq.extend(("B", m) for m in range(max(0, M - w), M))
-        seqs.append(seq)
-
-    pos = [0] * S
-    fdone = [[-1] * M for _ in range(S)]
-    bdone = [[-1] * M for _ in range(S)]
-    rows_f, rows_b, rows_mf, rows_mb = [], [], [], []
-    t = 0
-    while any(pos[i] < len(seqs[i]) for i in range(S)):
-        if t > 4 * (M + S) + 8:  # 2(M+S-1) expected; anything near 4x is a bug
-            raise RuntimeError(f"1F1B schedule failed to converge (S={S}, M={M})")
-        # decide every device against PRE-tick state, then commit
-        decisions = []
-        for i in range(S):
-            if pos[i] >= len(seqs[i]):
-                decisions.append(None)
-                continue
-            act, m = seqs[i][pos[i]]
-            if act == "F":
-                ready = i == 0 or 0 <= fdone[i - 1][m] < t
-            elif i == S - 1:
-                ready = 0 <= fdone[i][m] < t  # loss cotangent is local
-            else:
-                ready = 0 <= bdone[i + 1][m] < t
-            decisions.append((act, m) if ready else None)
-        rf, rb = [False] * S, [False] * S
-        rmf, rmb = [0] * S, [0] * S
-        for i, d in enumerate(decisions):
-            if d is None:
-                continue
-            act, m = d
-            if act == "F":
-                fdone[i][m] = t
-                rf[i], rmf[i] = True, m
-            else:
-                bdone[i][m] = t
-                rb[i], rmb[i] = True, m
-            pos[i] += 1
-        rows_f.append(rf)
-        rows_b.append(rb)
-        rows_mf.append(rmf)
-        rows_mb.append(rmb)
-        t += 1
+    ring = min(S, M)
+    # portfolio: D > 1 only helps interleaved placements; keep V = 1 on
+    # the canonical single-latch schedule
+    variants = [("bfirst", 1), ("ffirst", 1)] if V == 1 else \
+        [("bfirst", 1), ("ffirst", 1), ("bfirst", 2), ("ffirst", 2)]
+    best = None
+    for prio, depth in variants:
+        placed = _place(S, M, V, ring, depth, prio)
+        if placed is not None and (best is None or placed[2] < best[2]):
+            best = placed + (depth,)
+    if best is None:
+        raise RuntimeError(
+            f"1F1B schedule failed to converge (S={S}, M={M}, V={V})")
+    fdone, bdone, T, D = best
 
     # ---- safety proofs for the runtime's fixed-size buffers.  Real
     # exceptions, not asserts: a placement bug here means silently
     # corrupted gradients at runtime, and asserts vanish under -O.
-    def _prove(ok: bool, i: int, m: int, what: str):
+    def _prove(ok: bool, i: int, c: int, m: int, what: str):
         if not ok:
             raise RuntimeError(
-                f"1F1B schedule unsafe for S={S}, M={M}: {what} "
-                f"(device {i}, microbatch {m})"
+                f"1F1B schedule unsafe for S={S}, M={M}, V={V}: {what} "
+                f"(device {i}, chunk {c}, microbatch {m})"
             )
 
-    for i in range(S - 1):  # activation latch on edge i -> i+1
-        for m in range(M):
-            _prove(fdone[i][m] < fdone[i + 1][m], i, m, "act order")
-            if m + 1 < M:
-                _prove(fdone[i][m + 1] >= fdone[i + 1][m], i, m,
-                       "act latch overwritten before consumption")
-    for i in range(S - 1):  # cotangent latch on edge i+1 -> i
-        for m in range(M):
-            _prove(bdone[i + 1][m] < bdone[i][m], i, m, "cot order")
-            if m + 1 < M:
-                _prove(bdone[i + 1][m + 1] >= bdone[i][m], i, m,
-                       "cot latch overwritten before consumption")
-    ring = min(S, M)
-    for i in range(S):  # ring-slot reuse
-        for m in range(M - ring):
-            _prove(fdone[i][m + ring] > bdone[i][m], i, m,
-                   "ring slot reused while occupant still in flight")
+    for c in range(V):
+        for i in range(S):
+            # activation latch into device i's chunk c: produced by the
+            # left neighbor (or the S-1 -> 0 wrap from chunk c-1)
+            if i > 0:
+                prod = [fdone[i - 1][c][m] for m in range(M)]
+            elif c > 0:
+                prod = [fdone[S - 1][c - 1][m] for m in range(M)]
+            else:
+                prod = None  # embeds, no latch
+            if prod is not None:
+                cons = [fdone[i][c][m] for m in range(M)]
+                for m in range(M):
+                    _prove(prod[m] < cons[m], i, c, m, "act order")
+                    if m + D < M:
+                        _prove(prod[m + D] >= cons[m], i, c, m,
+                               "act latch overwritten before consumption")
+            # cotangent latch into device i's chunk c: produced by the
+            # right neighbor (or the 0 -> S-1 wrap from chunk c+1)
+            if i < S - 1:
+                prod = [bdone[i + 1][c][m] for m in range(M)]
+            elif c < V - 1:
+                prod = [bdone[0][c + 1][m] for m in range(M)]
+            else:
+                prod = None  # local loss, no latch
+            if prod is not None:
+                cons = [bdone[i][c][m] for m in range(M)]
+                for m in range(M):
+                    _prove(prod[m] < cons[m], i, c, m, "cot order")
+                    if m + D < M:
+                        _prove(prod[m + D] >= cons[m], i, c, m,
+                               "cot latch overwritten before consumption")
+    for i in range(S):  # ring-slot reuse, per chunk
+        for c in range(V):
+            for m in range(M - ring):
+                _prove(fdone[i][c][m + ring] > bdone[i][c][m], i, c, m,
+                       "ring slot reused while occupant still in flight")
 
-    is_fwd = np.asarray(rows_f, dtype=bool)
-    is_bwd = np.asarray(rows_b, dtype=bool)
-    fwd_mb = np.asarray(rows_mf, dtype=np.int32)
-    bwd_mb = np.asarray(rows_mb, dtype=np.int32)
-    left_fwd = np.zeros_like(is_fwd)
-    left_fwd[:, 1:] = is_fwd[:, :-1]
-    right_bwd = np.zeros_like(is_bwd)
-    right_bwd[:, :-1] = is_bwd[:, 1:]
+    # ---- timetable arrays from the placement
+    shape = (T, S)
+    is_fwd = np.zeros(shape, bool)
+    is_bwd = np.zeros(shape, bool)
+    fwd_mb = np.zeros(shape, np.int32)
+    bwd_mb = np.zeros(shape, np.int32)
+    fwd_chunk = np.zeros(shape, np.int32)
+    bwd_chunk = np.zeros(shape, np.int32)
+    for i in range(S):
+        for c in range(V):
+            for m in range(M):
+                tf, tb = fdone[i][c][m], bdone[i][c][m]
+                is_fwd[tf, i], fwd_mb[tf, i], fwd_chunk[tf, i] = True, m, c
+                is_bwd[tb, i], bwd_mb[tb, i], bwd_chunk[tb, i] = True, m, c
+
+    # receiver-side latch tables: device i latches the incoming
+    # activation when its ring-left neighbor fired a forward — into the
+    # same chunk, or chunk c+1 across the S-1 -> 0 wrap (the final
+    # logical stage's output latches nowhere: it is consumed by the
+    # head on device S-1 itself).  Cotangents mirror this to the left.
+    # Latch indices are FLAT: chunk·D + (mb mod D).
+    recv_act = np.zeros(shape, bool)
+    recv_act_ix = np.zeros(shape, np.int32)
+    recv_cot = np.zeros(shape, bool)
+    recv_cot_ix = np.zeros(shape, np.int32)
+    recv_act[:, 1:] = is_fwd[:, :-1]
+    recv_act_ix[:, 1:] = fwd_chunk[:, :-1] * D + fwd_mb[:, :-1] % D
+    wrap = is_fwd[:, S - 1] & (fwd_chunk[:, S - 1] < V - 1)
+    recv_act[:, 0] = wrap
+    recv_act_ix[:, 0] = np.where(
+        wrap, (fwd_chunk[:, S - 1] + 1) * D + fwd_mb[:, S - 1] % D, 0)
+    recv_cot[:, :-1] = is_bwd[:, 1:]
+    recv_cot_ix[:, :-1] = bwd_chunk[:, 1:] * D + bwd_mb[:, 1:] % D
+    wrap_b = is_bwd[:, 0] & (bwd_chunk[:, 0] > 0)
+    recv_cot[:, S - 1] = wrap_b
+    recv_cot_ix[:, S - 1] = np.where(
+        wrap_b, (bwd_chunk[:, 0] - 1) * D + bwd_mb[:, 0] % D, 0)
+
     return Schedule1F1B(
-        is_fwd, is_bwd, fwd_mb, bwd_mb,
+        is_fwd, is_bwd, fwd_mb, bwd_mb, fwd_chunk, bwd_chunk,
         (fwd_mb % ring).astype(np.int32), (bwd_mb % ring).astype(np.int32),
-        left_fwd, right_bwd,
+        (fwd_chunk * D + fwd_mb % D).astype(np.int32),
+        (bwd_chunk * D + bwd_mb % D).astype(np.int32),
+        recv_act, recv_act_ix, recv_cot, recv_cot_ix,
+        ring, V, D,
     )
+
+
+def _place(S, M, V, ring, D, prio):
+    """One greedy lockstep placement: returns ``(fdone, bdone, ticks)``
+    (tick of each action, [device][chunk][mb]) or None on non-
+    convergence.  ``prio`` picks which ready action a device fires:
+    ``bfirst`` retires the oldest ready backward (1F1B discipline),
+    ``ffirst`` advances the oldest ready forward and lets the memory
+    gates force backwards (depth-first, better at deep interleave)."""
+    fdone = [[[-1] * M for _ in range(V)] for _ in range(S)]
+    bdone = [[[-1] * M for _ in range(V)] for _ in range(S)]
+
+    def f_ready(i, c, m, t):
+        if fdone[i][c][m] >= 0:
+            return False
+        # upstream activation: left neighbor same chunk, or the S-1 -> 0
+        # chunk wrap; chunk 0 on device 0 embeds (always ready)
+        if i > 0:
+            if not 0 <= fdone[i - 1][c][m] < t:
+                return False
+        elif c > 0:
+            if not 0 <= fdone[S - 1][c - 1][m] < t:
+                return False
+        # ring-slot gate: the slot's previous occupant must be retired
+        prev = m - ring
+        if prev >= 0 and bdone[i][c][prev] < 0:
+            return False
+        # forwards of a chunk run in m order (keeps the in-flight window
+        # contiguous, which is what makes m % ring collision-free)
+        if m > 0 and fdone[i][c][m - 1] < 0:
+            return False
+        # depth-D latch gate: my activation m-D for this chunk must be
+        # consumed before value m lands — the dynamic counterpart of
+        # the classic warmup cap S-1-i
+        if m >= D:
+            if i < S - 1:
+                if not 0 <= fdone[i + 1][c][m - D] < t:
+                    return False
+            elif c < V - 1:
+                if not 0 <= fdone[0][c + 1][m - D] < t:
+                    return False
+        return True
+
+    def b_ready(i, c, m, t):
+        if bdone[i][c][m] >= 0 or fdone[i][c][m] < 0:
+            return False
+        if not fdone[i][c][m] < t:
+            return False
+        # depth-D latch gate for the cotangent channel (mirror of f_ready)
+        if m >= D:
+            if i > 0:
+                if not 0 <= bdone[i - 1][c][m - D] < t:
+                    return False
+            elif c > 0:
+                if not 0 <= bdone[S - 1][c - 1][m - D] < t:
+                    return False
+        if i == S - 1 and c == V - 1:
+            return True  # loss cotangent is local (own fwd checked above)
+        if i < S - 1:
+            return 0 <= bdone[i + 1][c][m] < t
+        return 0 <= bdone[0][c + 1][m] < t  # 0 -> S-1 chunk wrap
+
+    total = S * V * M
+    placed_f = placed_b = 0
+    t = 0
+    # the interleaved critical path alone is 2·S·V ticks (one full
+    # logical-pipeline traversal each way), so the non-convergence cap
+    # must scale with V·(M+S), not M+S — at S=8, M=1, V=4 the feasible
+    # schedule needs exactly 64 ticks
+    cap = 4 * V * (M + S) + 8
+    while placed_f < total or placed_b < total:
+        if t > cap:
+            return None
+        # decide every device against PRE-tick state, commit after
+        chosen = []
+        for i in range(S):
+            pick_b = pick_f = None
+            for m in range(M):
+                for c in reversed(range(V)):
+                    if b_ready(i, c, m, t):
+                        pick_b = ("B", c, m)
+                        break
+                if pick_b:
+                    break
+            for m in range(M):
+                for c in range(V):
+                    if f_ready(i, c, m, t):
+                        pick_f = ("F", c, m)
+                        break
+                if pick_f:
+                    break
+            chosen.append(
+                (pick_b or pick_f) if prio == "bfirst" else (pick_f or pick_b))
+        for i, pick in enumerate(chosen):
+            if pick is None:
+                continue
+            act, c, m = pick
+            if act == "F":
+                fdone[i][c][m] = t
+                placed_f += 1
+            else:
+                bdone[i][c][m] = t
+                placed_b += 1
+        t += 1
+    return fdone, bdone, t
 
 
 def pipeline_grads_1f1b(
@@ -243,19 +396,29 @@ def pipeline_grads_1f1b(
     axis: str = PIPE_AXIS,
     num_microbatches: Optional[int] = None,
     batch_axis: Optional[str] = None,
+    interleave: int = 1,
 ):
     """Build ``run(stacked_params, outer, inputs, labels) -> (loss,
     stage_grads, outer_grads)`` executing the full fwd+bwd 1F1B schedule.
 
     * ``stage_fn(stage_params, x) -> y`` — shape-preserving pipe stage
-      (``switch_stage``'s three-argument heterogeneous form and
+      (``switch_stage``'s three-argument heterogeneous form — which
+      receives the LOGICAL stage index ``chunk·S + device`` — and
       ``chunk_stages``-blocked virtual stages both compose);
-    * ``embed_fn(outer, inputs_mb) -> x0`` — stage-0 entry (e.g. token
-      embedding), re-run under ``vjp`` at backward ticks;
-    * ``head_fn(outer, y, labels_mb) -> scalar`` — stage-(S-1) exit:
-      per-microbatch mean loss.  The pipeline's loss is the mean over
-      microbatches; gradients match ``jax.grad`` of that composition
-      (tests/test_pp_1f1b.py proves it against the unpipelined model).
+    * ``embed_fn(outer, inputs_mb) -> x0`` — entry at logical stage 0,
+      re-run under ``vjp`` at backward ticks;
+    * ``head_fn(outer, y, labels_mb) -> scalar`` — exit at the final
+      logical stage: per-microbatch mean loss.  The pipeline's loss is
+      the mean over microbatches; gradients match ``jax.grad`` of that
+      composition (tests/test_pp_1f1b.py proves it against the
+      unpipelined model).
+
+    ``interleave=V`` runs the Megatron interleaved-virtual-stage
+    placement: ``stacked_params`` leaves carry a ``(S, V, ...)`` leading
+    layout where ``[i, c]`` is LOGICAL stage ``c·S + i`` (round-robin,
+    NOT the blocked ``chunk_stages`` layout), activations wrap
+    S-1 → 0 between chunks, and the fill/drain bubble shrinks ~V-fold
+    at the cost of V× the per-device latch/ring buffers.
 
     ``stage_grads`` come back stage-stacked (leading dim sharded on
     ``axis``) exactly like the input params — the optimizer update stays
@@ -268,20 +431,44 @@ def pipeline_grads_1f1b(
     """
     S = mesh.shape[axis]
     M = num_microbatches or S
-    sched = build_schedule(S, M)
-    ring = min(S, M)
+    V = interleave
+    sched = build_schedule(S, M, V)
+    ring = sched.ring
     with_stage = _accepts_stage(stage_fn)
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
     bwd_perm = [(i, (i - 1) % S) for i in range(S)]
     rows = tuple(
         jnp.asarray(a) for a in (
             sched.is_fwd, sched.is_bwd, sched.fwd_mb, sched.bwd_mb,
-            sched.fwd_slot, sched.bwd_slot, sched.left_fwd, sched.right_bwd,
+            sched.fwd_chunk, sched.bwd_chunk,
+            sched.fwd_slot, sched.bwd_slot,
+            sched.fwd_latch, sched.bwd_latch,
+            sched.recv_act, sched.recv_act_ix,
+            sched.recv_cot, sched.recv_cot_ix,
         )
     )
 
-    def apply_stage(sp, x, idx):
-        return stage_fn(sp, x, idx) if with_stage else stage_fn(sp, x)
+    def apply_stage(sp, x, logical_stage):
+        return stage_fn(sp, x, logical_stage) if with_stage else stage_fn(sp, x)
+
+    def chunk_tree(sp, c):
+        """Device-local params of chunk ``c``; identity when V = 1 (the
+        stacked layout then has no chunk dim, preserving the original
+        contract)."""
+        if V == 1:
+            return sp
+        return jax.tree.map(
+            lambda l: jax.lax.dynamic_index_in_dim(l, c, 0, keepdims=False), sp)
+
+    def chunk_scatter_add(g_sp, gs_c, c):
+        """Accumulate a chunk-c gradient into the (V, ...) tree."""
+        if V == 1:
+            return jax.tree.map(jnp.add, g_sp, gs_c)
+        return jax.tree.map(
+            lambda gl, gc: jax.lax.dynamic_update_index_in_dim(
+                gl, jax.lax.dynamic_index_in_dim(gl, c, 0, keepdims=False) + gc,
+                c, 0),
+            g_sp, gs_c)
 
     @partial(
         jax.shard_map,
@@ -324,6 +511,7 @@ def pipeline_grads_1f1b(
         sp = varying(sp)
         zero_act = varying(jnp.zeros(act.shape, act.dtype))
         zeros_sp = varying(jax.tree.map(jnp.zeros_like, sp))
+        zeros_chunk = varying(jax.tree.map(jnp.zeros_like, chunk_tree(sp, 0)))
         zeros_outer = varying(jax.tree.map(jnp.zeros_like, outer))
         f32_0 = varying(jnp.float32(0.0))
         # d(mean over microbatches)/d(l_m); varying like the vjp output
@@ -331,25 +519,32 @@ def pipeline_grads_1f1b(
 
         def tick(carry, row):
             h_act, h_cot, ringbuf, g_sp, g_out, loss_acc = carry
-            isf, isb, mfs, mbs, sfs, sbs, lfs, rbs = row
+            (isf, isb, mfs, mbs, cfs, cbs, sfs, sbs, lfs, lbs,
+             ras, rais, rcs, rcis) = row
             f = jnp.take(isf, idx)
             bk = jnp.take(isb, idx)
             mf, mb_ = jnp.take(mfs, idx), jnp.take(mbs, idx)
+            cf, cb = jnp.take(cfs, idx), jnp.take(cbs, idx)
             sf, sb = jnp.take(sfs, idx), jnp.take(sbs, idx)
+            lf, lb = jnp.take(lfs, idx), jnp.take(lbs, idx)
 
-            # ---- forward tick: (maybe embed) -> stage -> stash input
+            # ---- forward tick: (maybe embed) -> stage -> stash input.
+            # Buffers are (V, ring, ...) / latches (V, ...): chunk-
+            # indexed so interleaved placements keep V streams apart.
             def do_f(_):
                 x_in = jax.lax.cond(
-                    idx == 0,
+                    (idx == 0) & (cf == 0),
                     lambda _: _leaf_varying(
                         embed_fn(outer, jax.lax.dynamic_index_in_dim(
                             mb_in, mf, 0, keepdims=False))),
-                    lambda _: h_act,
+                    lambda _: jax.lax.dynamic_index_in_dim(
+                        h_act, lf, 0, keepdims=False),
                     None,
                 )
-                y = apply_stage(sp, x_in, idx)
-                return y, jax.lax.dynamic_update_index_in_dim(
-                    ringbuf, x_in, sf, 0)
+                y = apply_stage(chunk_tree(sp, cf), x_in, cf * S + idx)
+                slab = jax.lax.dynamic_index_in_dim(ringbuf, cf, 0, keepdims=False)
+                slab = jax.lax.dynamic_update_index_in_dim(slab, x_in, sf, 0)
+                return y, jax.lax.dynamic_update_index_in_dim(ringbuf, slab, cf, 0)
 
             y_send, ringbuf = jax.lax.cond(
                 f, do_f, lambda _: (zero_act, ringbuf), None)
@@ -357,26 +552,31 @@ def pipeline_grads_1f1b(
             # ---- backward tick: recompute fwd under vjp from the
             # stashed input, pull the cotangent through
             def do_b(_):
-                x_saved = jax.lax.dynamic_index_in_dim(
-                    ringbuf, sb, 0, keepdims=False)
+                slab = jax.lax.dynamic_index_in_dim(ringbuf, cb, 0, keepdims=False)
+                x_saved = jax.lax.dynamic_index_in_dim(slab, sb, 0, keepdims=False)
                 lab = jax.lax.dynamic_index_in_dim(
                     mb_lab, mb_, 0, keepdims=False)
+                pc = chunk_tree(sp, cb)
+                stage_ix = cb * S + idx
 
                 def last(_):
-                    def fn(sp_, out_, x_):
-                        return head_fn(out_, apply_stage(sp_, x_, idx), lab)
+                    def fn(pc_, out_, x_):
+                        return head_fn(out_, apply_stage(pc_, x_, stage_ix), lab)
 
-                    l, pull = jax.vjp(fn, sp, outer, x_saved)
+                    l, pull = jax.vjp(fn, pc, outer, x_saved)
                     gs, go, gx = pull(seed)
                     return gs, varying(go), gx, l
 
                 def inner(_):
                     y, pull = jax.vjp(
-                        lambda sp_, x_: apply_stage(sp_, x_, idx), sp, x_saved)
-                    gs, gx = pull(h_cot)
+                        lambda pc_, x_: apply_stage(pc_, x_, stage_ix),
+                        pc, x_saved)
+                    gs, gx = pull(jax.lax.dynamic_index_in_dim(
+                        h_cot, lb, 0, keepdims=False))
                     return gs, zeros_outer, gx, f32_0
 
-                gs, go, gx, l = jax.lax.cond(idx == S - 1, last, inner, None)
+                gs, go, gx, l = jax.lax.cond(
+                    (idx == S - 1) & (cb == V - 1), last, inner, None)
 
                 def embed_bwd(_):
                     tok = jax.lax.dynamic_index_in_dim(
@@ -385,13 +585,14 @@ def pipeline_grads_1f1b(
                     (go0,) = pull(gx)
                     return jax.tree.map(jnp.add, go, go0)
 
-                go = jax.lax.cond(idx == 0, embed_bwd, lambda _: go, None)
+                go = jax.lax.cond(
+                    (idx == 0) & (cb == 0), embed_bwd, lambda _: go, None)
                 return gs, go, gx, l
 
             gs_d, go_d, gx_send, l = jax.lax.cond(
                 bk, do_b,
-                lambda _: (zeros_sp, zeros_outer, zero_act, f32_0), None)
-            g_sp = jax.tree.map(jnp.add, g_sp, gs_d)
+                lambda _: (zeros_chunk, zeros_outer, zero_act, f32_0), None)
+            g_sp = chunk_scatter_add(g_sp, gs_d, cb)
             g_out = jax.tree.map(jnp.add, g_out, go_d)
             loss_acc = loss_acc + l
 
@@ -407,13 +608,23 @@ def pipeline_grads_1f1b(
             recv_a = jax.lax.ppermute(y_send, axis, fwd_perm)
             gx_send = jax.lax.optimization_barrier((gx_send, recv_a))[0]
             recv_c = jax.lax.ppermute(gx_send, axis, bwd_perm)
-            h_act = jnp.where(jnp.take(lfs, idx), recv_a, h_act)
-            h_cot = jnp.where(jnp.take(rbs, idx), recv_c, h_cot)
+            h_act = jnp.where(
+                jnp.take(ras, idx),
+                jax.lax.dynamic_update_index_in_dim(
+                    h_act, recv_a, jnp.take(rais, idx), 0),
+                h_act)
+            h_cot = jnp.where(
+                jnp.take(rcs, idx),
+                jax.lax.dynamic_update_index_in_dim(
+                    h_cot, recv_c, jnp.take(rcis, idx), 0),
+                h_cot)
             return (h_act, h_cot, ringbuf, g_sp, g_out, loss_acc), None
 
+        latch0 = varying(
+            jnp.zeros((V * sched.latch_depth,) + act.shape, act.dtype))
         ringbuf0 = varying(
-            jnp.zeros((ring,) + act.shape, act.dtype))
-        carry0 = (zero_act, zero_act, ringbuf0, zeros_sp, zeros_outer, f32_0)
+            jnp.zeros((V, ring) + act.shape, act.dtype))
+        carry0 = (latch0, latch0, ringbuf0, zeros_sp, zeros_outer, f32_0)
         (_, _, _, g_sp, g_out, loss_acc), _ = jax.lax.scan(tick, carry0, rows)
 
         loss = jax.lax.psum(loss_acc, axis) / M
@@ -428,7 +639,7 @@ def pipeline_grads_1f1b(
         return loss, jax.tree.map(lambda g: g[None], g_sp), g_out
 
     run.schedule = sched
-    run.utilization = 2 * M / sched.ticks
+    run.utilization = sched.utilization
     return run
 
 
@@ -441,6 +652,7 @@ def make_train_step_1f1b(
     axis: str = PIPE_AXIS,
     num_microbatches: Optional[int] = None,
     batch_axis: Optional[str] = None,
+    interleave: int = 1,
     donate: bool = True,
     input_key: str = "tokens",
     label_key: Optional[str] = None,
@@ -457,6 +669,7 @@ def make_train_step_1f1b(
     run = pipeline_grads_1f1b(
         stage_fn, embed_fn, head_fn, mesh, axis=axis,
         num_microbatches=num_microbatches, batch_axis=batch_axis,
+        interleave=interleave,
     )
     repl = NamedSharding(mesh, P())
     state_shardings = split_state_shardings(mesh, axis)
