@@ -262,19 +262,21 @@ def main():
         "error": str(last_err),
     }
     # If a background probe loop has been retrying the chip (the r4+
-    # availability workflow, docs/benchmarks.md), attach its evidence so
-    # a zero artifact shows the outage was continuously probed, not
-    # unattended.
-    try:
-        log = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           ".bench_probe_r4.log")
-        with open(log) as f:
-            lines = [ln.strip() for ln in f if ln.strip()]
+    # availability workflow: benchmarks/hw_watch.sh, docs/benchmarks.md),
+    # attach its evidence so a zero artifact shows the outage was
+    # continuously probed, not unattended.
+    here = os.path.dirname(os.path.abspath(__file__))
+    for log in (os.path.join(here, "benchmarks", "hw", "watch.log"),
+                os.path.join(here, ".bench_probe_r4.log")):
+        try:
+            with open(log) as f:
+                lines = [ln.strip() for ln in f if ln.strip()]
+        except OSError:
+            continue
         if lines:
-            out["probe_attempts"] = len(lines)
-            out["probe_last"] = lines[-1][:200]
-    except OSError:
-        pass
+            out.setdefault("probe_logs", {})[os.path.basename(log)] = {
+                "lines": len(lines), "last": lines[-1][:200],
+            }
     print(json.dumps(out))
 
 

@@ -40,6 +40,14 @@ CONFIGS = [
     {"name": "s2d-fuse-8", "env": {"SWEEP_S2D": "1", "SWEEP_FUSE": "8"}},
     {"name": "latency-hiding-sched", "env": {
         "SWEEP_XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=true"}},
+    # full lever stack: if individual levers help, their combination is
+    # the real headline candidate
+    {"name": "s2d-lhs-512", "env": {
+        "SWEEP_S2D": "1", "SWEEP_BATCH": "512",
+        "SWEEP_XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=true"}},
+    {"name": "s2d-lhs-fuse-8", "env": {
+        "SWEEP_S2D": "1", "SWEEP_FUSE": "8",
+        "SWEEP_XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=true"}},
     {"name": "batch-512", "env": {"SWEEP_BATCH": "512"}},
     {"name": "lhs-batch-512", "env": {
         "SWEEP_BATCH": "512",
