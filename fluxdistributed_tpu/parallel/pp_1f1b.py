@@ -672,6 +672,9 @@ def make_train_step_1f1b(
         interleave=interleave,
     )
     repl = NamedSharding(mesh, P())
+    # under DP composition the batch arrives data-sharded (the
+    # shard_batch layout), not replicated
+    batch_sh = NamedSharding(mesh, P(batch_axis)) if batch_axis else repl
     state_shardings = split_state_shardings(mesh, axis)
 
     def step(state: TrainState, batch):
@@ -692,7 +695,7 @@ def make_train_step_1f1b(
         sh = state_shardings(state)
         return jax.jit(
             step,
-            in_shardings=(sh, repl),
+            in_shardings=(sh, batch_sh),
             out_shardings=(sh, repl),
             donate_argnums=(0,) if donate else (),
         )

@@ -102,6 +102,66 @@ def main() -> int:
         _check_shards(lg, lw, f"PP grad {path_got}", rtol=1e-4, atol=1e-3)
     print(f"worker {pid}: PP backward parity OK", flush=True)
 
+    # ---- hand-scheduled 1F1B across the process boundary --------------
+    # per-tick activation AND cotangent ppermutes, per-device cond
+    # divergence, and the end-of-scan psums all cross the DCN stand-in
+    from fluxdistributed_tpu.parallel.pp_1f1b import pipeline_grads_1f1b
+
+    DIN, NCLS = 8, 6
+
+    def embed_fn(outer, xin):
+        return jnp.tanh(xin @ outer["w_in"])
+
+    def head_fn(outer, y, labels):
+        logp = jax.nn.log_softmax(y @ outer["w_out"])
+        return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+
+    okeys = jax.random.split(jax.random.PRNGKey(5), 2)
+    outer = {
+        "w_in": jax.random.normal(okeys[0], (DIN, D), jnp.float32) * 0.4,
+        "w_out": jax.random.normal(okeys[1], (D, NCLS), jnp.float32) * 0.4,
+    }
+    rng1 = np.random.default_rng(6)
+    xb = jnp.asarray(rng1.normal(0, 1, (16, DIN)).astype(np.float32))
+    labels = jnp.asarray(
+        np.eye(NCLS, dtype=np.float32)[rng1.integers(0, NCLS, 16)])
+
+    run = pipeline_grads_1f1b(
+        stage_fn, embed_fn, head_fn, mesh, num_microbatches=8)
+    loss, g_stages, g_outer = jax.jit(run)(
+        stacked, sharding.replicate(outer, mesh),
+        sharding.replicate(xb, mesh), sharding.replicate(labels, mesh))
+
+    m_ = 8
+    xs = xb.reshape(m_, 16 // m_, DIN)
+    ls = labels.reshape(m_, 16 // m_, NCLS)
+
+    def ref_loss(outer_, stages_):
+        def one(x_mb, l_mb):
+            h = embed_fn(outer_, x_mb)
+            for p in stages_:
+                h = stage_fn(p, h)
+            return head_fn(outer_, h, l_mb)
+
+        return jnp.mean(jax.vmap(one)(xs, ls))
+
+    loss_ref, (go_ref, gs_ref) = jax.value_and_grad(
+        ref_loss, argnums=(0, 1))(outer, per_stage)
+    np.testing.assert_allclose(float(loss), float(loss_ref), rtol=1e-5)
+    want_gs = jax.tree.map(
+        lambda *vs: np.stack([np.asarray(v) for v in vs]), *gs_ref)
+    for (path_got, lg), (_, lw) in zip(
+        jax.tree_util.tree_flatten_with_path(g_stages)[0],
+        jax.tree_util.tree_flatten_with_path(want_gs)[0],
+    ):
+        _check_shards(lg, lw, f"1F1B stage grad {path_got}", rtol=1e-4, atol=1e-4)
+    for (path_got, lg), (_, lw) in zip(
+        jax.tree_util.tree_flatten_with_path(g_outer)[0],
+        jax.tree_util.tree_flatten_with_path(go_ref)[0],
+    ):
+        _check_shards(lg, lw, f"1F1B outer grad {path_got}", rtol=1e-4, atol=1e-4)
+    print(f"worker {pid}: 1F1B cross-process parity OK", flush=True)
+
     # ---- expert parallelism (MoE all_to_all) across the boundary ------
     E = n_dev
     T = 64

@@ -90,7 +90,8 @@ def test_two_process_pipeline_and_moe():
     )
     for i, out in enumerate(outs):
         assert f"worker {i}: OK" in out, out[-3000:]
-        for part in ("PP forward", "PP backward", "EP forward", "EP backward"):
+        for part in ("PP forward", "PP backward", "1F1B cross-process",
+                     "EP forward", "EP backward"):
             assert f"{part} parity OK" in out, (part, out[-3000:])
 
 
