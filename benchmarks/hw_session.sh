@@ -11,6 +11,12 @@
 # deliberately far above any healthy stage duration. Do NOT kill stages
 # by hand.
 #
+# Deadline policy: when HW_DEADLINE_EPOCH is set (hw_watch.sh exports
+# it), each stage launches only if its FULL timeout bound fits before
+# the deadline — the stage boundary is the kill-free safe point, so a
+# session can never hold the one-client grant into the driver's
+# official bench window. Skipped stages are logged, not silently lost.
+#
 #   sh benchmarks/hw_session.sh [outdir]          # default benchmarks/hw
 #
 # Each stage appends to its own file so a mid-session outage loses
@@ -21,33 +27,43 @@ cd "$(dirname "$0")/.."
 OUT="${1:-benchmarks/hw}"
 mkdir -p "$OUT"
 stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+DEADLINE="${HW_DEADLINE_EPOCH:-0}"
+
+fits() { # fits <seconds>: does a stage bounded at <seconds> fit?
+    [ "$DEADLINE" = 0 ] && return 0
+    if [ $(( $(date +%s) + $1 )) -gt "$DEADLINE" ]; then
+        echo "[$(stamp)] skipping next stage: its ${1}s bound would straddle the deadline" | tee -a "$OUT/session.log"
+        return 1
+    fi
+    return 0
+}
 
 echo "[$(stamp)] 1/7 headline bench" | tee -a "$OUT/session.log"
-timeout 3000 python bench.py >> "$OUT/bench.jsonl" 2>> "$OUT/session.log"
+fits 3000 && timeout 3000 python bench.py >> "$OUT/bench.jsonl" 2>> "$OUT/session.log"
 
 echo "[$(stamp)] 2/7 step sweep (leverage-ordered; fuse rows isolate tunnel dispatch)" | tee -a "$OUT/session.log"
-# no outer timeout: every sweep child self-bounds at 1800s, and killing
-# the parent would orphan a TPU child still holding the device grant
-python benchmarks/step_sweep.py >> "$OUT/sweep.jsonl" 2>> "$OUT/session.log"
+# no outer timeout: every sweep child self-bounds at 1800s and the
+# parent stops between children once SWEEP_DEADLINE_EPOCH approaches —
+# killing the parent would orphan a TPU child still holding the grant
+fits 1800 && SWEEP_DEADLINE_EPOCH="$DEADLINE" python benchmarks/step_sweep.py >> "$OUT/sweep.jsonl" 2>> "$OUT/session.log"
 
 echo "[$(stamp)] 3/7 trace analysis" | tee -a "$OUT/session.log"
-timeout 3600 python benchmarks/trace_analysis.py >> "$OUT/trace.txt" 2>> "$OUT/session.log"
+fits 3600 && timeout 3600 python benchmarks/trace_analysis.py >> "$OUT/trace.txt" 2>> "$OUT/session.log"
 
 echo "[$(stamp)] 4/7 step segments + cost analysis" | tee -a "$OUT/session.log"
-timeout 3600 python benchmarks/train_step_segments.py >> "$OUT/segments.txt" 2>> "$OUT/session.log"
+fits 3600 && timeout 3600 python benchmarks/train_step_segments.py >> "$OUT/segments.txt" 2>> "$OUT/session.log"
 
 echo "[$(stamp)] 5/7 LM benches" | tee -a "$OUT/session.log"
-timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 1024 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
-timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 2048 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
-timeout 2700 python benchmarks/lm_bench.py --model lm_medium --seqlen 1024 --batch 8 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
-timeout 2700 python benchmarks/lm_bench.py --model lm_medium --seqlen 1024 --batch 8 --remat >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
+fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 1024 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
+fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 2048 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
+fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_medium --seqlen 1024 --batch 8 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
+fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_medium --seqlen 1024 --batch 8 --remat >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
 
 echo "[$(stamp)] 6/7 end-to-end ingest" | tee -a "$OUT/session.log"
-timeout 3600 python benchmarks/ingest_e2e.py --steps 20 >> "$OUT/ingest.jsonl" 2>> "$OUT/session.log"
-timeout 3600 python benchmarks/ingest_e2e.py --steps 20 --s2d >> "$OUT/ingest.jsonl" 2>> "$OUT/session.log"
-
+fits 3600 && timeout 3600 python benchmarks/ingest_e2e.py --steps 20 >> "$OUT/ingest.jsonl" 2>> "$OUT/session.log"
+fits 3600 && timeout 3600 python benchmarks/ingest_e2e.py --steps 20 --s2d >> "$OUT/ingest.jsonl" 2>> "$OUT/session.log"
 
 echo "[$(stamp)] 7/7 attention-core microbench" | tee -a "$OUT/session.log"
-timeout 2700 python benchmarks/attention_bench.py >> "$OUT/attention.jsonl" 2>> "$OUT/session.log"
+fits 2700 && timeout 2700 python benchmarks/attention_bench.py >> "$OUT/attention.jsonl" 2>> "$OUT/session.log"
 
 echo "[$(stamp)] session complete (incl. attention)" | tee -a "$OUT/session.log"

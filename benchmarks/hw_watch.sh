@@ -30,8 +30,14 @@ stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
 # a while); override with WATCH_DEADLINE_EPOCH.
 DEADLINE="${WATCH_DEADLINE_EPOCH:-$(( $(date +%s) + 8 * 3600 ))}"
 
-# wait for any in-flight bench client (grant contention wedges init)
+# wait for any in-flight bench client (grant contention wedges init);
+# the .stop kill file is honored here too, or a wedged client would
+# make the watcher ignore stop requests forever
 while pgrep -f "bench\.py --one" > /dev/null 2>&1; do
+    if [ -e "$OUT/.stop" ]; then
+        echo "[$(stamp)] watch: stop file present while waiting; exiting"
+        exit 0
+    fi
     echo "[$(stamp)] watch: waiting for in-flight bench client"
     sleep 60
 done
@@ -62,9 +68,12 @@ done
 
 # chip is granting: run the rest of the staged chain (stage 1 re-runs
 # bench.py, giving the required second reproduction of the headline) —
-# but only with >= 2 h of runway (a session straddling the deadline
-# would hold the client slot into the driver's official bench window),
-# and only if no stop was requested while the last attempt ran
+# but only with >= 2 h of runway, and only if no stop was requested
+# while the last attempt ran.  The 2 h gate alone cannot bound the
+# whole chain (the stages' summed worst-case timeouts far exceed it),
+# so the deadline is EXPORTED: hw_session checks it before each stage
+# and step_sweep between children — the kill-free safe points — and
+# they skip whatever no longer fits.
 if [ -e "$OUT/.stop" ]; then
     echo "[$(stamp)] watch: stop file present; keeping only the captured bench row"
     exit 0
@@ -73,6 +82,6 @@ if [ $(( DEADLINE - $(date +%s) )) -lt 7200 ]; then
     echo "[$(stamp)] watch: <2h to deadline; keeping only the captured bench row"
     exit 0
 fi
-echo "[$(stamp)] watch: launching full hw_session"
-sh benchmarks/hw_session.sh "$OUT"
+echo "[$(stamp)] watch: launching full hw_session (deadline $(date -u -d "@$DEADLINE" +%H:%MZ 2>/dev/null || echo "$DEADLINE"))"
+HW_DEADLINE_EPOCH="$DEADLINE" sh benchmarks/hw_session.sh "$OUT"
 echo "[$(stamp)] watch: hw_session complete"

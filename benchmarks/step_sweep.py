@@ -18,6 +18,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 # bench.py (the shared timing protocol) lives at the repo root
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -119,8 +120,17 @@ def main():
         print(json.dumps(measure_one()))
         return
 
+    # hw_session exports this: between children is the only kill-free
+    # place to stop (a SIGKILLed TPU child can wedge the device grant),
+    # so the parent checks the deadline here and skips what no longer
+    # fits a child's 1800 s self-bound
+    deadline = int(os.environ.get("SWEEP_DEADLINE_EPOCH", "0") or 0)
     results = []
     for cfg in CONFIGS:
+        if deadline and time.time() + 1800 > deadline:
+            print(json.dumps({"config": cfg["name"],
+                              "error": "skipped: deadline"}), flush=True)
+            continue
         env = {**os.environ, **cfg["env"]}
         # APPEND sweep flags to pre-existing XLA_FLAGS so the row stays
         # comparable to the others (which inherit the environment's flags)

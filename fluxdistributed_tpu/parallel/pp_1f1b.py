@@ -122,6 +122,7 @@ class Schedule1F1B(NamedTuple):
     ring: int
     n_chunks: int
     latch_depth: int
+    max_in_flight: int
 
     @property
     def ticks(self) -> int:
@@ -133,6 +134,43 @@ class Schedule1F1B(NamedTuple):
         T ticks (identical per device; device 0's count is used)."""
         busy = int(self.is_fwd[:, 0].sum() + self.is_bwd[:, 0].sum())
         return busy / self.ticks
+
+    def render(self, max_ticks: int = 120) -> str:
+        """ASCII timetable, one row per device, one column per tick:
+        ``F3``/``B3`` = forward/backward of microbatch 3 (lowercase
+        ``f``/``b`` + chunk digit replaces the letter when V > 1, e.g.
+        ``f1:3`` → chunk 1, microbatch 3), ``.`` = idle.  Eyeball the
+        warmup ramp, the 1F1B steady state, and the drain directly:
+
+        >>> print(build_schedule(4, 8).render())
+        """
+        T, S = self.is_fwd.shape
+        V = self.n_chunks
+        cells = []
+        width = 0
+        for i in range(S):
+            row = []
+            for t in range(min(T, max_ticks)):
+                if self.is_fwd[t, i]:
+                    c = (f"F{self.fwd_mb[t, i]}" if V == 1 else
+                         f"f{self.fwd_chunk[t, i]}:{self.fwd_mb[t, i]}")
+                elif self.is_bwd[t, i]:
+                    c = (f"B{self.bwd_mb[t, i]}" if V == 1 else
+                         f"b{self.bwd_chunk[t, i]}:{self.bwd_mb[t, i]}")
+                else:
+                    c = "."
+                width = max(width, len(c))
+                row.append(c)
+            cells.append(row)
+        lines = [
+            f"dev{i} " + " ".join(c.rjust(width) for c in row)
+            for i, row in enumerate(cells)
+        ]
+        tail = "" if T <= max_ticks else f"\n... ({T - max_ticks} more ticks)"
+        head = (f"1F1B schedule: S={S} M={int(self.is_fwd[:, 0].sum()) // V} "
+                f"V={V} T={T} util={self.utilization:.3f} "
+                f"in-flight<={self.max_in_flight}")
+        return head + "\n" + "\n".join(lines) + tail
 
 
 def build_schedule(S: int, M: int, V: int = 1) -> Schedule1F1B:
@@ -174,18 +212,26 @@ def build_schedule(S: int, M: int, V: int = 1) -> Schedule1F1B:
 
     ring = min(S, M)
     # portfolio: D > 1 only helps interleaved placements; keep V = 1 on
-    # the canonical single-latch schedule
+    # the canonical single-latch schedule.  Ties on tick count break
+    # toward the placement with fewer in-flight microbatches (less
+    # stash memory) — e.g. a forward-greedy member that merely matches
+    # backward-first on ticks must not win on memory-hungrier shape.
     variants = [("bfirst", 1), ("ffirst", 1)] if V == 1 else \
         [("bfirst", 1), ("ffirst", 1), ("bfirst", 2), ("ffirst", 2)]
-    best = None
+    best = best_key = None
     for prio, depth in variants:
         placed = _place(S, M, V, ring, depth, prio)
-        if placed is not None and (best is None or placed[2] < best[2]):
-            best = placed + (depth,)
+        if placed is None:
+            continue
+        fdone_v, bdone_v, ticks_v, max_if_v = placed
+        key = (ticks_v, max_if_v)
+        if best_key is None or key < best_key:
+            best_key = key
+            best = (fdone_v, bdone_v, ticks_v, max_if_v, depth)
     if best is None:
         raise RuntimeError(
             f"1F1B schedule failed to converge (S={S}, M={M}, V={V})")
-    fdone, bdone, T, D = best
+    fdone, bdone, T, max_in_flight, D = best
 
     # ---- safety proofs for the runtime's fixed-size buffers.  Real
     # exceptions, not asserts: a placement bug here means silently
@@ -279,17 +325,18 @@ def build_schedule(S: int, M: int, V: int = 1) -> Schedule1F1B:
         (fwd_chunk * D + fwd_mb % D).astype(np.int32),
         (bwd_chunk * D + bwd_mb % D).astype(np.int32),
         recv_act, recv_act_ix, recv_cot, recv_cot_ix,
-        ring, V, D,
+        ring, V, D, max_in_flight,
     )
 
 
 def _place(S, M, V, ring, D, prio):
-    """One greedy lockstep placement: returns ``(fdone, bdone, ticks)``
-    (tick of each action, [device][chunk][mb]) or None on non-
-    convergence.  ``prio`` picks which ready action a device fires:
-    ``bfirst`` retires the oldest ready backward (1F1B discipline),
-    ``ffirst`` advances the oldest ready forward and lets the memory
-    gates force backwards (depth-first, better at deep interleave)."""
+    """One greedy lockstep placement: returns ``(fdone, bdone, ticks,
+    max_in_flight)`` (tick of each action, [device][chunk][mb]; peak
+    stashed microbatches on any device) or None on non-convergence.
+    ``prio`` picks which ready action a device fires: ``bfirst``
+    retires the oldest ready backward (1F1B discipline), ``ffirst``
+    advances the oldest ready forward and lets the memory gates force
+    backwards (depth-first, better at deep interleave)."""
     fdone = [[[-1] * M for _ in range(V)] for _ in range(S)]
     bdone = [[[-1] * M for _ in range(V)] for _ in range(S)]
 
@@ -385,7 +432,21 @@ def _place(S, M, V, ring, D, prio):
                 bdone[i][c][m] = t
                 placed_b += 1
         t += 1
-    return fdone, bdone, t
+
+    # peak stashed microbatches on any device (fwd done, bwd not yet)
+    max_if = 0
+    for i in range(S):
+        events = []
+        for c in range(V):
+            for m in range(M):
+                events.append((fdone[i][c][m], 1))
+                events.append((bdone[i][c][m], -1))
+        events.sort()
+        cur = 0
+        for _, d in events:
+            cur += d
+            max_if = max(max_if, cur)
+    return fdone, bdone, t, max_if
 
 
 def pipeline_grads_1f1b(

@@ -48,6 +48,19 @@ def test_schedule_ticks_and_counts(s, m):
     assert not (sched.is_fwd & sched.is_bwd).any()
 
 
+def test_schedule_render_and_memory_fields():
+    sched = build_schedule(4, 8)
+    text = sched.render()
+    # canonical facts visible in the rendering
+    assert "S=4 M=8 V=1 T=22" in text
+    assert text.count("\n") == 4  # header + one row per device
+    assert "F7" in text and "B7" in text
+    # 1F1B memory bound: in-flight never exceeds min(S, M)
+    assert sched.max_in_flight <= 4
+    # interleaved render uses chunk-qualified cells
+    assert "f1:" in build_schedule(4, 4, 2).render()
+
+
 @pytest.mark.parametrize("s,m,v", [(4, 4, 2), (4, 8, 2), (8, 8, 2), (4, 8, 4), (2, 4, 3)])
 def test_interleaved_schedule_beats_blocked(s, m, v):
     """Interleaving exists to shrink the bubble: at these (moderate-M)
