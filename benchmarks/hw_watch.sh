@@ -9,7 +9,7 @@
 #   nohup sh benchmarks/hw_watch.sh >> benchmarks/hw/watch.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
-OUT="benchmarks/hw"
+OUT="${1:-benchmarks/hw}"
 mkdir -p "$OUT"
 LOCK="$OUT/.watch.lock"
 exec 9> "$LOCK"
@@ -29,6 +29,18 @@ stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
 # (computed before the wait-for-in-flight loop, which can itself take
 # a while); override with WATCH_DEADLINE_EPOCH.
 DEADLINE="${WATCH_DEADLINE_EPOCH:-$(( $(date +%s) + 8 * 3600 ))}"
+
+# a stop request or an already-unreachable deadline exits BEFORE the
+# wait-for-in-flight loop: with a wedged client in flight, waiting
+# first would delay (or swallow) an exit that needs no waiting at all
+if [ -e "$OUT/.stop" ]; then
+    echo "[$(stamp)] watch: stop file present; exiting"
+    exit 0
+fi
+if [ "$(date +%s)" -ge "$(( DEADLINE - 2400 ))" ]; then
+    echo "[$(stamp)] watch: no attempt fits before the deadline; exiting"
+    exit 0
+fi
 
 # wait for any in-flight bench client (grant contention wedges init);
 # the .stop kill file is honored here too, or a wedged client would

@@ -1,0 +1,79 @@
+"""Deadline discipline of the hardware-benchmark chain.
+
+The axon runtime grants ONE TPU client at a time, and the driver runs
+the official bench.py at round end — so no watcher attempt, session
+stage, or sweep child may hold (or queue for) the grant past the
+exported deadline.  These tests drive the chain's skip paths with an
+already-passed deadline: everything must decline to launch, quickly,
+without ever creating a TPU client.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_hw_session_skips_every_stage_past_deadline(tmp_path):
+    env = {**os.environ, "HW_DEADLINE_EPOCH": str(int(time.time()))}
+    t0 = time.monotonic()
+    p = subprocess.run(
+        ["sh", os.path.join(REPO, "benchmarks", "hw_session.sh"), str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=60,
+    )
+    assert p.returncode == 0, p.stderr[-500:]
+    assert time.monotonic() - t0 < 30, "skip path must not launch anything slow"
+    log = (tmp_path / "session.log").read_text()
+    # all 11 stage launches declined; the chain still runs to completion
+    assert log.count("skipping next stage") == 11, log
+    assert "session complete" in log
+    # nothing produced measurement output
+    assert not (tmp_path / "bench.jsonl").exists()
+
+
+def test_step_sweep_stops_before_deadline():
+    env = {**os.environ, "SWEEP_DEADLINE_EPOCH": "1", "SWEEP_PLATFORM": "cpu"}
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "step_sweep.py")],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert p.returncode == 0, p.stderr[-500:]
+    # structural check, robust to config-list edits: every per-config
+    # line declined, nothing measured.  (Skipped configs never reach the
+    # results list, so the {"sweep": []} summary contributes no rows.)
+    per_config = [
+        ln for ln in p.stdout.splitlines()
+        if '"config"' in ln and '"sweep"' not in ln
+    ]
+    assert per_config, p.stdout[-800:]
+    assert all('"skipped: deadline"' in ln for ln in per_config), p.stdout[-800:]
+    assert '"img_per_sec_per_chip"' not in p.stdout
+
+
+def test_hw_watch_declines_past_deadline(tmp_path):
+    """With an expired deadline the watcher exits via the early
+    no-attempt-fits gate — BEFORE the wait-for-in-flight loop, so a
+    wedged orphan client cannot stall the exit.  OUT is pointed at a
+    scratch dir so a live production watcher's flock on benchmarks/hw
+    cannot shadow the path under test."""
+    t0 = time.monotonic()
+    p = subprocess.run(
+        ["sh", os.path.join(REPO, "benchmarks", "hw_watch.sh"), str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "WATCH_DEADLINE_EPOCH": "1"},
+    )
+    assert p.returncode == 0, p.stderr[-500:]
+    assert "no attempt fits before the deadline" in p.stdout, p.stdout
+    assert time.monotonic() - t0 < 20
+
+
+def test_hw_watch_honors_stop_file(tmp_path):
+    (tmp_path / ".stop").touch()
+    p = subprocess.run(
+        ["sh", os.path.join(REPO, "benchmarks", "hw_watch.sh"), str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert p.returncode == 0, p.stderr[-500:]
+    assert "stop file present" in p.stdout, p.stdout
