@@ -70,7 +70,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--final-eval", action="store_true",
                    help="after training, aggregate loss/top-k over the FULL "
                         "--val-dataset with train.evaluate")
-    p.add_argument("--spmd", default="jit", choices=["jit", "shard_map", "fsdp", "tp", "fsdp_tp"])
+    p.add_argument("--spmd", default="jit",
+                   choices=["jit", "shard_map", "fsdp", "tp", "fsdp_tp",
+                            "pp", "pp_1f1b"])
     p.add_argument("--steps-per-call", type=int, default=1,
                    help="optimizer steps per dispatch (device loop; spmd=jit). "
                         "Amortizes host dispatch when the runtime is tunneled")
@@ -78,6 +80,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="model-axis size for --spmd tp / fsdp_tp (mesh "
                         "becomes {data: N/tp, model: tp}; required for "
                         "fsdp_tp, defaults to all devices for tp)")
+    p.add_argument("--pipe", type=int, default=None,
+                   help="pipe-axis size for --spmd pp / pp_1f1b (mesh "
+                        "becomes {data: N/pipe, pipe: pipe}; defaults to "
+                        "all devices, i.e. data=1)")
+    p.add_argument("--microbatches", type=int, default=None,
+                   help="pipeline microbatches per step (default 2x pipe "
+                        "size; the (S-1)/(M+S-1) bubble shrinks as M grows)")
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--wandb", action="store_true", help="log to Weights & Biases")
     # manual cluster bring-up (CPU fake cluster / debugging)
@@ -166,6 +175,10 @@ def main(argv=None) -> int:
 
     if args.tp is not None and args.spmd not in ("tp", "fsdp_tp"):
         raise SystemExit("--tp only applies with --spmd tp or fsdp_tp")
+    if args.pipe is not None and args.spmd not in ("pp", "pp_1f1b"):
+        raise SystemExit("--pipe only applies with --spmd pp or pp_1f1b")
+    if args.microbatches is not None and args.spmd not in ("pp", "pp_1f1b"):
+        raise SystemExit("--microbatches only applies with --spmd pp or pp_1f1b")
     if args.spmd in ("tp", "fsdp_tp"):
         from fluxdistributed_tpu.mesh import make_mesh
 
@@ -179,6 +192,15 @@ def main(argv=None) -> int:
         if tp < 1 or ndev % tp:
             raise SystemExit(f"--tp {tp} must be >=1 and divide {ndev} devices")
         mesh = make_mesh({"data": ndev // tp, "model": tp})
+    elif args.spmd in ("pp", "pp_1f1b"):
+        from fluxdistributed_tpu.mesh import make_mesh
+
+        ndev = jax.device_count()
+        pipe = args.pipe if args.pipe is not None else ndev
+        if pipe < 2 or ndev % pipe:
+            raise SystemExit(f"--pipe {pipe} must be >=2 and divide {ndev} devices")
+        mesh = make_mesh({"data": ndev // pipe, "pipe": pipe})
+        lm_extra["num_microbatches"] = args.microbatches
     else:
         mesh = fd.data_mesh()
     if multihost.is_coordinator():

@@ -68,6 +68,11 @@ class TrainTask:
     # optimizer steps per dispatch (the device loop); loader items carry
     # this many stacked batches and metrics come back stacked
     steps_per_call: int = 1
+    # every global batch fed to step_fn/eval_fn must be a multiple of
+    # this (0 = just the data-axis size).  Pipeline modes set it to
+    # data_size x num_microbatches: the compiled schedule reshapes each
+    # data shard into M microbatches, so eval/val batches must divide
+    batch_quantum: int = 0
 
 
 def prepare_training(
@@ -92,6 +97,7 @@ def prepare_training(
     accum_steps: int = 1,
     transform: Optional[Callable] = None,
     steps_per_call: int = 1,
+    num_microbatches: Optional[int] = None,
 ) -> TrainTask:
     """Initialize params, compile the SPMD step, build prefetch loaders.
 
@@ -161,6 +167,7 @@ def prepare_training(
 
     if loss_fn is None:
         loss_fn = flax_loss_fn(model, loss)
+    batch_quantum = 0  # pipeline modes raise it to data_size x microbatches
     if spmd in ("tp", "fsdp_tp"):
         # Megatron tensor parallelism over a (data, model) mesh; sharding
         # rules picked by model family ("fsdp_tp" additionally
@@ -212,6 +219,72 @@ def prepare_training(
             loss_fn, mesh, topk=tuple(topk),
             state_shardings=make_shardings(state_specs(state, specs), mesh),
         )
+    elif spmd in ("pp", "pp_1f1b"):
+        # Pipeline-parallel LM training as a first-class trainer mode:
+        # decoder blocks stage-sharded over a 'pipe' axis, composed with
+        # data parallelism over the 'data' axis (size 1 is fine — build
+        # the mesh as make_mesh({"data": D, "pipe": S})).  "pp" rides
+        # the GPipe schedule through the generic jit step; "pp_1f1b"
+        # compiles the hand-scheduled 1F1B train step (O(S) activation
+        # memory) and still evaluates through the GPipe forward — both
+        # schedules share the same split param tree and shardings.
+        from ..models.transformer_lm import TransformerLM, lm_pp, lm_pp_1f1b
+        from ..parallel.pp_1f1b import make_train_step_1f1b
+
+        if not isinstance(model, TransformerLM):
+            raise ValueError(
+                f"spmd={spmd!r} supports TransformerLM only (CNN stages "
+                "change activation shapes mid-network)"
+            )
+        if accum_steps != 1:
+            raise ValueError("accum_steps > 1 requires spmd='jit' or 'fsdp'")
+        for ax in ("pipe", mesh_lib.DATA_AXIS):
+            if ax not in mesh.shape:
+                raise ValueError(
+                    f"spmd={spmd!r} needs a mesh with 'data' and 'pipe' "
+                    "axes, e.g. make_mesh({'data': 1, 'pipe': 8})"
+                )
+        if model_state:
+            raise ValueError(
+                f"spmd={spmd!r} supports stateless models only "
+                f"(got model_state collections {list(model_state)})"
+            )
+        S = mesh.shape["pipe"]
+        n_data = mesh.shape[mesh_lib.DATA_AXIS]
+        if num_microbatches is not None and num_microbatches < 1:
+            raise ValueError(
+                f"num_microbatches must be >= 1, got {num_microbatches}")
+        M = num_microbatches or 2 * S
+        per_row = batch_size // n_data
+        if batch_size % n_data or per_row % M:
+            raise ValueError(
+                f"batch_size {batch_size} must split into data axis "
+                f"{n_data} x microbatches {M} (per-row batch {per_row})"
+            )
+        batch_quantum = n_data * M
+
+        split_params, pp_loss_fn, shardings_fn = lm_pp(
+            model, mesh, batch_axis=mesh_lib.DATA_AXIS, num_microbatches=M
+        )
+        state = TrainState.create(split_params(params), optimizer)
+        sh = shardings_fn(state)
+        state = jax.tree.map(jax.device_put, state, sh)
+        if spmd == "pp":
+            step_fn = make_train_step(
+                pp_loss_fn, optimizer, mesh, axis=mesh_lib.DATA_AXIS,
+                donate=donate, state_shardings=sh,
+            )
+        else:
+            w = lm_pp_1f1b(model, mesh)
+            step_fn = make_train_step_1f1b(
+                *w.fns, optimizer, mesh, num_microbatches=M,
+                batch_axis=mesh_lib.DATA_AXIS, interleave=w.interleave,
+                donate=donate,
+            )(state)
+        # eval through the GPipe forward: same tree, same shardings
+        eval_fn = make_eval_step(
+            pp_loss_fn, mesh, topk=tuple(topk), state_shardings=sh
+        )
     elif spmd == "fsdp":
         from ..parallel import fsdp as fsdp_lib
 
@@ -258,8 +331,11 @@ def prepare_training(
 
     val_batch = None
     if val_dataset is not None:
-        n = mesh.shape[mesh_lib.DATA_AXIS]
-        nval = max(n, (val_samples // n) * n)  # divisible val slice
+        # divisible val slice: a data-axis multiple, and for pipeline
+        # modes a multiple of data_size x microbatches (the compiled
+        # eval reshapes each data shard into M microbatches)
+        q = batch_quantum or mesh.shape[mesh_lib.DATA_AXIS]
+        nval = max(q, (val_samples // q) * q)
         # Validation must go through the eval pipeline even when the val
         # dataset was carved from an augmenting train table — force train
         # augmentation off for this draw.
@@ -290,6 +366,7 @@ def prepare_training(
         val_batch=val_batch,
         transform=transform,
         steps_per_call=steps_per_call,
+        batch_quantum=batch_quantum,
     )
 
 
@@ -375,32 +452,37 @@ def evaluate(
         and "indices" in inspect.signature(dataset.batch).parameters
     )
     n_axis = task.mesh.shape.get(mesh_lib.DATA_AXIS, 1)
+    # the granularity every fed batch must divide into: the data axis,
+    # raised to data_size x microbatches for pipeline tasks (their
+    # compiled eval reshapes each data shard into M microbatches)
+    quantum = task.batch_quantum or n_axis
     requested = batch_size
     if capable:
         # batch must stay shardable on the data axis AND inside the
         # dataset; shrink it for small datasets instead of indexing past
         # the end
-        max_bs = len(dataset) // n_axis * n_axis
+        max_bs = len(dataset) // quantum * quantum
         if max_bs == 0:
             raise ValueError(
                 f"dataset has {len(dataset)} samples — fewer than the "
-                f"{n_axis}-way data axis; cannot build one shardable batch"
+                f"batch granularity {quantum} (data axis {n_axis}); "
+                "cannot build one shardable batch"
             )
         batch_size = min(batch_size, max_bs)
-    # caller-supplied sizes must land on a data-axis multiple on BOTH
-    # paths (indexed and sampled), or shard_batch raises mid-eval
-    batch_size = batch_size // n_axis * n_axis
+    # caller-supplied sizes must land on a quantum multiple on BOTH
+    # paths (indexed and sampled), or the compiled eval raises mid-run
+    batch_size = batch_size // quantum * quantum
     if batch_size == 0:
         raise ValueError(
-            f"batch_size {requested} rounds down to 0 on the "
-            f"{n_axis}-way data axis; pass batch_size >= {n_axis}"
+            f"batch_size {requested} rounds down to 0 at batch "
+            f"granularity {quantum}; pass batch_size >= {quantum}"
         )
     rem_size = 0
     if capable:
         full_batches = len(dataset) // batch_size
         # trailing remainder, rounded to a shardable size: runs as one
-        # extra smaller batch so coverage misses < n_axis samples
-        rem_size = (len(dataset) - full_batches * batch_size) // n_axis * n_axis
+        # extra smaller batch so coverage misses < quantum samples
+        rem_size = (len(dataset) - full_batches * batch_size) // quantum * quantum
     if max_batches is None:
         if not hasattr(dataset, "__len__"):
             raise ValueError(
