@@ -156,9 +156,14 @@ def main(argv=None) -> int:
         raise SystemExit("--final-eval needs --val-dataset")
     if is_lm:
         # LM protocol: vocab-sized model, next-token loss, no top-k image
-        # metrics; cycles must be explicit (the text stream is unbounded)
+        # metrics; cycles must be explicit (the text stream is unbounded).
+        # Pipeline modes build their own per-microbatch loss — passing a
+        # loss_fn there is an error by design (trainer raises).
         model = model_fn(vocab=args.vocab)
-        lm_extra = {"loss_fn": models.lm_loss_fn(model), "topk": ()}
+        if args.spmd in ("pp", "pp_1f1b"):
+            lm_extra = {"topk": ()}
+        else:
+            lm_extra = {"loss_fn": models.lm_loss_fn(model), "topk": ()}
         if args.cycles is None and not hasattr(dataset, "__len__"):
             raise SystemExit("--cycles is required for unbounded token "
                              "streams (synthetic-text has no epoch length; "

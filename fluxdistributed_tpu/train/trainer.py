@@ -73,6 +73,10 @@ class TrainTask:
     # data_size x num_microbatches: the compiled schedule reshapes each
     # data shard into M microbatches, so eval/val batches must divide
     batch_quantum: int = 0
+    # the top-k metrics compiled into eval_fn; ``train`` reports these
+    # by default so a mode that compiles loss-only eval (the LM
+    # pipelines) needs no caller-side coordination
+    topk: tuple = (1, 5, 10)
 
 
 def prepare_training(
@@ -146,6 +150,8 @@ def prepare_training(
         raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
     if steps_per_call != 1 and spmd != "jit":
         raise ValueError("steps_per_call > 1 requires spmd='jit'")
+    if num_microbatches is not None and spmd not in ("pp", "pp_1f1b"):
+        raise ValueError("num_microbatches requires spmd='pp' or 'pp_1f1b'")
     mesh = mesh or mesh_lib.data_mesh()
     if input_shape is not None:
         dummy = np.zeros((1, *input_shape), np.float32)
@@ -165,6 +171,7 @@ def prepare_training(
     params = variables["params"]
     model_state = {k: v for k, v in variables.items() if k != "params"}  # e.g. batch_stats
 
+    custom_loss_fn = loss_fn is not None
     if loss_fn is None:
         loss_fn = flax_loss_fn(model, loss)
     batch_quantum = 0  # pipeline modes raise it to data_size x microbatches
@@ -238,6 +245,14 @@ def prepare_training(
             )
         if accum_steps != 1:
             raise ValueError("accum_steps > 1 requires spmd='jit' or 'fsdp'")
+        if custom_loss_fn:
+            raise ValueError(
+                f"spmd={spmd!r} trains on the pipeline's own per-microbatch "
+                "next-token loss; a loss_fn override cannot apply (drop it)"
+            )
+        # top-k image metrics can never apply to the LM pipeline; the
+        # compiled eval returns loss only
+        topk = ()
         for ax in ("pipe", mesh_lib.DATA_AXIS):
             if ax not in mesh.shape:
                 raise ValueError(
@@ -249,6 +264,22 @@ def prepare_training(
                 f"spmd={spmd!r} supports stateless models only "
                 f"(got model_state collections {list(model_state)})"
             )
+        if spmd == "pp_1f1b":
+            # the 1F1B step's per-microbatch loss reads tokens only; a
+            # mask-carrying dataset would train unmasked while eval (the
+            # GPipe forward) applies the mask — reject the divergence
+            from ..data.loader import batch_to_dict
+
+            probe = batch_to_dict(
+                apply_transform(transform, dataset.batch(np.random.default_rng(0), 1)),
+                getattr(dataset, "nclasses", None),
+            )
+            if "mask" in probe:
+                raise ValueError(
+                    "spmd='pp_1f1b' does not support batch['mask'] (the "
+                    "1F1B per-microbatch loss reads tokens only) — use "
+                    "spmd='pp', whose loss applies the mask"
+                )
         S = mesh.shape["pipe"]
         n_data = mesh.shape[mesh_lib.DATA_AXIS]
         if num_microbatches is not None and num_microbatches < 1:
@@ -367,6 +398,7 @@ def prepare_training(
         transform=transform,
         steps_per_call=steps_per_call,
         batch_quantum=batch_quantum,
+        topk=tuple(topk),
     )
 
 
@@ -432,15 +464,18 @@ def evaluate(
     Coverage semantics: when the dataset supports explicit ``indices``
     and has a length, every sample is drawn EXACTLY once via sequential
     index blocks; a trailing remainder runs as one extra smaller batch
-    (its own compile — shapes are static), so at most ``n_axis - 1``
-    samples are ever dropped (only when the dataset size itself is not a
-    data-axis multiple).  Otherwise — generated token streams etc. —
-    batches are sampled and ``max_batches`` is required (the result is
-    then a stochastic estimate, flagged by ``"exact": False``).
+    (its own compile — shapes are static), so at most ``quantum - 1``
+    samples are ever dropped, where ``quantum`` is the task's batch
+    granularity: the data-axis size for most modes, raised to
+    ``data_size × num_microbatches`` for pipeline tasks (whose compiled
+    eval reshapes each data shard into M microbatches).  Otherwise —
+    generated token streams etc. — batches are sampled and
+    ``max_batches`` is required (the result is then a stochastic
+    estimate, flagged by ``"exact": False``).
 
     Returns sample-weighted means ``{"loss": ..., "top1": ..., ...}``
     plus ``"samples"``, ``"exact"``, and (on the exact path) ``"dropped"``
-    — the < n_axis unreachable leftovers.  Requested top-k metrics must
+    — the < quantum unreachable leftovers.  Requested top-k metrics must
     have been compiled into the eval step (``prepare_training(topk=...)``).
     """
     import inspect
@@ -542,8 +577,8 @@ def evaluate(
     out["samples"] = n
     out["exact"] = exact
     if exact:
-        # < n_axis samples can be unreachable when the dataset size is
-        # not a data-axis multiple; report the honest count
+        # < quantum samples can be unreachable when the dataset size is
+        # not a multiple of the batch granularity; report the honest count
         out["dropped"] = len(dataset) - n
     return out
 
@@ -553,7 +588,7 @@ def train(
     *,
     print_every: int = 10,
     eval_every: int = 50,
-    topk: Sequence[int] = (1, 5, 10),
+    topk: Optional[Sequence[int]] = None,
     sched: Optional[Callable] = None,
     logger: Optional[Logger] = None,
     checkpoint_dir: Optional[str] = None,
@@ -579,6 +614,10 @@ def train(
     model copy the reference returns from ``train`` (:241-246).
     """
     logger = logger or current_logger()
+    if topk is None:
+        # report exactly the metrics compiled into the task's eval step
+        # (loss-only for the LM pipeline modes)
+        topk = getattr(task, "topk", (1, 5, 10))
     t_start = time.time()
     t_mark, j_mark = t_start, 0
     profiling = False

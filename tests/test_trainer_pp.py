@@ -135,3 +135,32 @@ def test_pp_mode_rejects_bad_configs(pp_mesh):
             mesh=pp_mesh, batch_size=16, spmd="pp", topk=(),
             num_microbatches=3,  # 8 per row not divisible by 3
         )
+    with pytest.raises(ValueError, match="loss_fn override"):
+        from fluxdistributed_tpu.models import lm_loss_fn
+
+        m = _model()
+        prepare_training(
+            m, ds, optim.adam(1e-3),
+            mesh=pp_mesh, batch_size=16, spmd="pp", topk=(),
+            loss_fn=lm_loss_fn(m),
+        )
+    with pytest.raises(ValueError, match="num_microbatches requires"):
+        prepare_training(
+            _model(), ds, optim.adam(1e-3),
+            mesh=pp_mesh, batch_size=16, spmd="jit", topk=(),
+            num_microbatches=8,
+        )
+
+
+def test_pp_mode_coerces_image_topk_away(pp_mesh):
+    """The default image topk=(1,5,10) can never apply to the LM
+    pipeline; prepare_training forces loss-only eval instead of
+    crashing at the first eval cadence."""
+    ds = SyntheticTextDataset(vocab=VOCAB, seqlen=24)
+    task = prepare_training(
+        _model(), ds, optim.adam(1e-3),
+        mesh=pp_mesh, batch_size=16, cycles=1, spmd="pp",
+        num_microbatches=4, val_dataset=ds, val_samples=8,
+    )  # note: default topk
+    loss, metrics = task.eval_fn(task.state, task.val_batch)
+    assert np.isfinite(float(loss)) and metrics == {}
