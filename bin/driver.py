@@ -87,6 +87,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--microbatches", type=int, default=None,
                    help="pipeline microbatches per step (default 2x pipe "
                         "size; the (S-1)/(M+S-1) bubble shrinks as M grows)")
+    p.add_argument("--pp-interleave", action="store_true",
+                   help="Megatron interleaved virtual stages for --spmd "
+                        "pp_1f1b (depth/pipe chunks per device; ~V-fold "
+                        "smaller fill/drain bubble)")
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--wandb", action="store_true", help="log to Weights & Biases")
     # manual cluster bring-up (CPU fake cluster / debugging)
@@ -184,6 +188,8 @@ def main(argv=None) -> int:
         raise SystemExit("--pipe only applies with --spmd pp or pp_1f1b")
     if args.microbatches is not None and args.spmd not in ("pp", "pp_1f1b"):
         raise SystemExit("--microbatches only applies with --spmd pp or pp_1f1b")
+    if args.pp_interleave and args.spmd != "pp_1f1b":
+        raise SystemExit("--pp-interleave only applies with --spmd pp_1f1b")
     if args.spmd in ("tp", "fsdp_tp"):
         from fluxdistributed_tpu.mesh import make_mesh
 
@@ -206,6 +212,7 @@ def main(argv=None) -> int:
             raise SystemExit(f"--pipe {pipe} must be >=2 and divide {ndev} devices")
         mesh = make_mesh({"data": ndev // pipe, "pipe": pipe})
         lm_extra["num_microbatches"] = args.microbatches
+        lm_extra["pipeline_interleave"] = args.pp_interleave
     else:
         mesh = fd.data_mesh()
     if multihost.is_coordinator():

@@ -102,6 +102,7 @@ def prepare_training(
     transform: Optional[Callable] = None,
     steps_per_call: int = 1,
     num_microbatches: Optional[int] = None,
+    pipeline_interleave: bool = False,
 ) -> TrainTask:
     """Initialize params, compile the SPMD step, build prefetch loaders.
 
@@ -152,17 +153,23 @@ def prepare_training(
         raise ValueError("steps_per_call > 1 requires spmd='jit'")
     if num_microbatches is not None and spmd not in ("pp", "pp_1f1b"):
         raise ValueError("num_microbatches requires spmd='pp' or 'pp_1f1b'")
+    if pipeline_interleave and spmd != "pp_1f1b":
+        raise ValueError(
+            "pipeline_interleave requires spmd='pp_1f1b' (the hand-written "
+            "schedule; GPipe-via-AD cannot interleave)")
     mesh = mesh or mesh_lib.data_mesh()
+    init_draw = None
     if input_shape is not None:
         dummy = np.zeros((1, *input_shape), np.float32)
     else:
         # draw one real sample so init sees the dataset's true shape AND
-        # dtype (f32 images, int32 tokens, ...)
+        # dtype (f32 images, int32 tokens, ...); kept for the pp_1f1b
+        # mask probe below so startup draws only once
         from ..data.loader import model_input
 
-        dummy = model_input(
-            apply_transform(transform, dataset.batch(np.random.default_rng(0), 1))
-        )
+        init_draw = apply_transform(
+            transform, dataset.batch(np.random.default_rng(0), 1))
+        dummy = model_input(init_draw)
 
     p_rng, d_rng = jax.random.split(jax.random.PRNGKey(seed))
     # 'dropout' stream present at init so stochastic models (ViT dropout,
@@ -270,10 +277,9 @@ def prepare_training(
             # GPipe forward) applies the mask — reject the divergence
             from ..data.loader import batch_to_dict
 
-            probe = batch_to_dict(
-                apply_transform(transform, dataset.batch(np.random.default_rng(0), 1)),
-                getattr(dataset, "nclasses", None),
-            )
+            draw = init_draw if init_draw is not None else apply_transform(
+                transform, dataset.batch(np.random.default_rng(0), 1))
+            probe = batch_to_dict(draw, getattr(dataset, "nclasses", None))
             if "mask" in probe:
                 raise ValueError(
                     "spmd='pp_1f1b' does not support batch['mask'] (the "
@@ -294,28 +300,62 @@ def prepare_training(
             )
         batch_quantum = n_data * M
 
-        split_params, pp_loss_fn, shardings_fn = lm_pp(
-            model, mesh, batch_axis=mesh_lib.DATA_AXIS, num_microbatches=M
-        )
-        state = TrainState.create(split_params(params), optimizer)
-        sh = shardings_fn(state)
-        state = jax.tree.map(jax.device_put, state, sh)
-        if spmd == "pp":
-            step_fn = make_train_step(
-                pp_loss_fn, optimizer, mesh, axis=mesh_lib.DATA_AXIS,
-                donate=donate, state_shardings=sh,
-            )
-        else:
-            w = lm_pp_1f1b(model, mesh)
+        if pipeline_interleave:
+            # interleaved placement's round-robin param layout cannot
+            # feed the (blocked) GPipe forward, so BOTH the train step
+            # and eval ride the 1F1B program (eval returns its loss and
+            # discards the grads — ~3x a forward, fine for val slices)
+            from ..parallel.pp_1f1b import pipeline_grads_1f1b
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            w = lm_pp_1f1b(model, mesh, interleave=True)
+            state = TrainState.create(w.split_params(params), optimizer)
+            sh = w.state_shardings(state)
+            state = jax.tree.map(jax.device_put, state, sh)
             step_fn = make_train_step_1f1b(
                 *w.fns, optimizer, mesh, num_microbatches=M,
                 batch_axis=mesh_lib.DATA_AXIS, interleave=w.interleave,
                 donate=donate,
             )(state)
-        # eval through the GPipe forward: same tree, same shardings
-        eval_fn = make_eval_step(
-            pp_loss_fn, mesh, topk=tuple(topk), state_shardings=sh
-        )
+            eval_run = pipeline_grads_1f1b(
+                *w.fns, mesh, num_microbatches=M,
+                batch_axis=mesh_lib.DATA_AXIS, interleave=w.interleave,
+            )
+
+            def _eval(state, batch):
+                loss, _, _ = eval_run(
+                    state.params["stages"], state.params["outer"],
+                    batch["tokens"], batch["tokens"],
+                )
+                return loss, {}
+
+            eval_fn = jax.jit(
+                _eval,
+                in_shardings=(sh, NamedSharding(mesh, P(mesh_lib.DATA_AXIS))),
+            )
+        else:
+            split_params, pp_loss_fn, shardings_fn = lm_pp(
+                model, mesh, batch_axis=mesh_lib.DATA_AXIS, num_microbatches=M
+            )
+            state = TrainState.create(split_params(params), optimizer)
+            sh = shardings_fn(state)
+            state = jax.tree.map(jax.device_put, state, sh)
+            if spmd == "pp":
+                step_fn = make_train_step(
+                    pp_loss_fn, optimizer, mesh, axis=mesh_lib.DATA_AXIS,
+                    donate=donate, state_shardings=sh,
+                )
+            else:
+                w = lm_pp_1f1b(model, mesh)
+                step_fn = make_train_step_1f1b(
+                    *w.fns, optimizer, mesh, num_microbatches=M,
+                    batch_axis=mesh_lib.DATA_AXIS, interleave=w.interleave,
+                    donate=donate,
+                )(state)
+            # eval through the GPipe forward: same tree, same shardings
+            eval_fn = make_eval_step(
+                pp_loss_fn, mesh, topk=tuple(topk), state_shardings=sh
+            )
     elif spmd == "fsdp":
         from ..parallel import fsdp as fsdp_lib
 
@@ -454,7 +494,7 @@ def evaluate(
     *,
     batch_size: int = 256,
     max_batches: Optional[int] = None,
-    topk: Sequence[int] = (1, 5, 10),
+    topk: Optional[Sequence[int]] = None,
     seed: int = 0,
 ) -> dict:
     """Aggregate loss/top-k over a dataset with the compiled eval step —
@@ -481,6 +521,11 @@ def evaluate(
     import inspect
 
     from ..data.loader import apply_transform, batch_to_dict
+
+    if topk is None:
+        # report exactly the metrics compiled into the task's eval step
+        # (loss-only for the LM pipeline modes) — same default as train()
+        topk = getattr(task, "topk", (1, 5, 10))
 
     capable = (
         hasattr(dataset, "__len__")

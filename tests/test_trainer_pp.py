@@ -152,6 +152,35 @@ def test_pp_mode_rejects_bad_configs(pp_mesh):
         )
 
 
+def test_pp_1f1b_interleaved_trainer_mode(pp_mesh):
+    """pipeline_interleave=True: depth 8 on pipe 4 -> V=2 round-robin
+    chunks; trains through the full trainer surface and evals via the
+    1F1B program itself (the GPipe forward cannot read that layout)."""
+    ds = SyntheticTextDataset(vocab=VOCAB, seqlen=24, peak=0.95)
+    model = TransformerLM(
+        vocab=VOCAB, dim=32, depth=8, num_heads=2, mlp_dim=64,
+        dtype=jnp.float32, dropout=0.0,
+    )
+    task = prepare_training(
+        model, ds, optim.adam(3e-3),
+        mesh=pp_mesh, batch_size=16, cycles=20, topk=(),
+        spmd="pp_1f1b", num_microbatches=4, pipeline_interleave=True,
+        val_dataset=ds, val_samples=8,
+    )
+    losses = []
+    for batch in task.loader:
+        task.state, m = task.step_fn(task.state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+    loss, metrics = task.eval_fn(task.state, task.val_batch)
+    assert np.isfinite(float(loss)) and metrics == {}
+    with pytest.raises(ValueError, match="pipeline_interleave requires"):
+        prepare_training(
+            model, ds, optim.adam(1e-3), mesh=pp_mesh, batch_size=16,
+            spmd="pp", topk=(), pipeline_interleave=True,
+        )
+
+
 def test_pp_mode_coerces_image_topk_away(pp_mesh):
     """The default image topk=(1,5,10) can never apply to the LM
     pipeline; prepare_training forces loss-only eval instead of
