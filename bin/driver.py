@@ -72,7 +72,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "--val-dataset with train.evaluate")
     p.add_argument("--spmd", default="jit",
                    choices=["jit", "shard_map", "fsdp", "tp", "fsdp_tp",
-                            "pp", "pp_1f1b"])
+                            "pp", "pp_1f1b", "ep"])
     p.add_argument("--steps-per-call", type=int, default=1,
                    help="optimizer steps per dispatch (device loop; spmd=jit). "
                         "Amortizes host dispatch when the runtime is tunneled")
@@ -91,6 +91,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Megatron interleaved virtual stages for --spmd "
                         "pp_1f1b (depth/pipe chunks per device; ~V-fold "
                         "smaller fill/drain bubble)")
+    p.add_argument("--expert-parallel", type=int, default=None,
+                   help="expert-axis size for --spmd ep (mesh becomes "
+                        "{data: N/ep, expert: ep}; defaults to all devices)")
+    p.add_argument("--experts", type=int, default=None,
+                   help="number of MoE experts for --spmd ep (multiple of "
+                        "the expert axis; defaults to the axis size)")
+    p.add_argument("--moe-every", type=int, default=None,
+                   help="route every K-th decoder block through the MoE "
+                        "layer (--spmd ep; default 2)")
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--wandb", action="store_true", help="log to Weights & Biases")
     # manual cluster bring-up (CPU fake cluster / debugging)
@@ -158,12 +167,41 @@ def main(argv=None) -> int:
         )
     if args.final_eval and args.val_dataset is None:
         raise SystemExit("--final-eval needs --val-dataset")
+    # MoE expert parallelism: the model's moe_fn closes over the mesh,
+    # so the expert mesh is built BEFORE the model for this mode
+    ep_mesh = None
+    moe_kwargs = {}
+    if args.spmd == "ep":
+        from fluxdistributed_tpu.mesh import make_mesh
+        from fluxdistributed_tpu.parallel.ep import moe_apply
+
+        if not is_lm:
+            raise SystemExit("--spmd ep needs an lm_* model (MoE blocks)")
+        ndev = jax.device_count()
+        ep = args.expert_parallel if args.expert_parallel is not None else ndev
+        if ep < 2 or ndev % ep:
+            raise SystemExit(f"--expert-parallel {ep} must be >=2 and divide "
+                             f"{ndev} devices")
+        nex = args.experts if args.experts is not None else ep
+        if nex % ep:
+            raise SystemExit(f"--experts {nex} must be a multiple of the "
+                             f"expert axis size {ep}")
+        ep_mesh = make_mesh({"data": ndev // ep, "expert": ep})
+        moe_kwargs = {
+            "moe_every": args.moe_every if args.moe_every is not None else 2,
+            "num_experts": nex,
+            "moe_fn": moe_apply(
+                models.moe_expert_fn, ep_mesh, capacity_factor=2.0,
+                batch_axis="data",
+            ),
+        }
+
     if is_lm:
         # LM protocol: vocab-sized model, next-token loss, no top-k image
         # metrics; cycles must be explicit (the text stream is unbounded).
         # Pipeline modes build their own per-microbatch loss — passing a
         # loss_fn there is an error by design (trainer raises).
-        model = model_fn(vocab=args.vocab)
+        model = model_fn(vocab=args.vocab, **moe_kwargs)
         if args.spmd in ("pp", "pp_1f1b"):
             lm_extra = {"topk": ()}
         else:
@@ -190,6 +228,10 @@ def main(argv=None) -> int:
         raise SystemExit("--microbatches only applies with --spmd pp or pp_1f1b")
     if args.pp_interleave and args.spmd != "pp_1f1b":
         raise SystemExit("--pp-interleave only applies with --spmd pp_1f1b")
+    if (args.expert_parallel is not None or args.experts is not None
+            or args.moe_every is not None) and args.spmd != "ep":
+        raise SystemExit(
+            "--expert-parallel/--experts/--moe-every only apply with --spmd ep")
     if args.spmd in ("tp", "fsdp_tp"):
         from fluxdistributed_tpu.mesh import make_mesh
 
@@ -213,6 +255,8 @@ def main(argv=None) -> int:
         mesh = make_mesh({"data": ndev // pipe, "pipe": pipe})
         lm_extra["num_microbatches"] = args.microbatches
         lm_extra["pipeline_interleave"] = args.pp_interleave
+    elif args.spmd == "ep":
+        mesh = ep_mesh
     else:
         mesh = fd.data_mesh()
     if multihost.is_coordinator():

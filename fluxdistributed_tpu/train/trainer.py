@@ -356,6 +356,41 @@ def prepare_training(
             eval_fn = make_eval_step(
                 pp_loss_fn, mesh, topk=tuple(topk), state_shardings=sh
             )
+    elif spmd == "ep":
+        # MoE expert parallelism as a trainer mode: expert-stacked
+        # leaves shard over the 'expert' axis, tokens ride the 'data'
+        # axis, and the model's mesh-bound moe_fn (moe_apply) does the
+        # all_to_all dispatch inside the generic jit step.  The model
+        # must have been CONSTRUCTED with that moe_fn — it closes over
+        # the mesh (bin/driver.py builds it from --spmd ep flags).
+        from ..models.transformer_lm import TransformerLM, lm_loss_fn, lm_moe_specs
+        from ..parallel.tp import state_specs
+        from ..sharding import make_shardings
+
+        if not isinstance(model, TransformerLM) or not model.moe_every:
+            raise ValueError(
+                "spmd='ep' needs a TransformerLM with moe_every > 0 and a "
+                "mesh-bound moe_fn (models.moe_expert_fn via ep.moe_apply)"
+            )
+        if accum_steps != 1:
+            raise ValueError("accum_steps > 1 requires spmd='jit' or 'fsdp'")
+        for ax in ("expert", mesh_lib.DATA_AXIS):
+            if ax not in mesh.shape:
+                raise ValueError(
+                    "spmd='ep' needs a mesh with 'data' and 'expert' axes, "
+                    "e.g. make_mesh({'data': 1, 'expert': 8})"
+                )
+        if not custom_loss_fn:
+            loss_fn = lm_loss_fn(model)  # token protocol, not image loss
+        topk = ()  # image metrics can never apply to the LM
+        state = TrainState.create(params, optimizer, model_state=model_state)
+        sh = make_shardings(state_specs(state, lm_moe_specs(params)), mesh)
+        state = jax.tree.map(jax.device_put, state, sh)
+        step_fn = make_train_step(
+            loss_fn, optimizer, mesh, axis=mesh_lib.DATA_AXIS,
+            donate=donate, state_shardings=sh,
+        )
+        eval_fn = make_eval_step(loss_fn, mesh, topk=(), state_shardings=sh)
     elif spmd == "fsdp":
         from ..parallel import fsdp as fsdp_lib
 

@@ -1,0 +1,74 @@
+"""MoE expert parallelism as a first-class trainer mode.
+
+``prepare_training(spmd="ep")`` shards the MoE LM's expert-stacked
+leaves over the mesh's ``expert`` axis while tokens ride the ``data``
+axis; the model's mesh-bound ``moe_fn`` performs the all_to_all
+dispatch inside the generic jit step.  Rides the full trainer surface.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fluxdistributed_tpu import mesh as mesh_lib, optim
+from fluxdistributed_tpu.data import SyntheticTextDataset
+from fluxdistributed_tpu.models import moe_expert_fn
+from fluxdistributed_tpu.models.transformer_lm import TransformerLM
+from fluxdistributed_tpu.parallel.ep import moe_apply
+from fluxdistributed_tpu.train import prepare_training
+
+VOCAB = 32
+
+
+@pytest.fixture(scope="module")
+def ep_mesh():
+    return mesh_lib.make_mesh({"data": 2, "expert": 4})
+
+
+def _moe_model(mesh, experts=8):
+    return TransformerLM(
+        vocab=VOCAB, dim=32, depth=2, num_heads=2, mlp_dim=64,
+        dtype=jnp.float32, dropout=0.0,
+        moe_every=2, num_experts=experts,
+        moe_fn=moe_apply(moe_expert_fn, mesh, capacity_factor=2.0,
+                         batch_axis="data"),
+    )
+
+
+def test_ep_trainer_mode_trains_and_evaluates(ep_mesh):
+    ds = SyntheticTextDataset(vocab=VOCAB, seqlen=24, peak=0.95)
+    task = prepare_training(
+        _moe_model(ep_mesh), ds, optim.adam(3e-3),
+        mesh=ep_mesh, batch_size=16, cycles=40, spmd="ep",
+        val_dataset=ds, val_samples=8,
+    )  # default topk: coerced to loss-only for the LM
+    # expert-stacked leaves are sharded over the expert axis: each
+    # device holds 2 of the 8 experts
+    w1 = task.state.params["block1"]["w1"]
+    assert w1.shape[0] == 8 and w1.addressable_shards[0].data.shape[0] == 2
+    losses = []
+    for batch in task.loader:
+        task.state, m = task.step_fn(task.state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    loss, metrics = task.eval_fn(task.state, task.val_batch)
+    assert np.isfinite(float(loss)) and metrics == {}
+
+
+def test_ep_mode_rejects_bad_configs(ep_mesh):
+    ds = SyntheticTextDataset(vocab=VOCAB, seqlen=24)
+    dense = TransformerLM(
+        vocab=VOCAB, dim=32, depth=2, num_heads=2, mlp_dim=64,
+        dtype=jnp.float32, dropout=0.0,
+    )
+    with pytest.raises(ValueError, match="moe_every > 0"):
+        prepare_training(
+            dense, ds, optim.adam(1e-3),
+            mesh=ep_mesh, batch_size=16, spmd="ep", topk=(),
+        )
+    with pytest.raises(ValueError, match="expert"):
+        prepare_training(
+            _moe_model(ep_mesh), ds, optim.adam(1e-3),
+            mesh=mesh_lib.data_mesh(8), batch_size=16, spmd="ep", topk=(),
+        )
