@@ -72,7 +72,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "--val-dataset with train.evaluate")
     p.add_argument("--spmd", default="jit",
                    choices=["jit", "shard_map", "fsdp", "tp", "fsdp_tp",
-                            "pp", "pp_1f1b", "ep"])
+                            "pp", "pp_1f1b", "ep", "sp"])
     p.add_argument("--steps-per-call", type=int, default=1,
                    help="optimizer steps per dispatch (device loop; spmd=jit). "
                         "Amortizes host dispatch when the runtime is tunneled")
@@ -100,6 +100,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe-every", type=int, default=None,
                    help="route every K-th decoder block through the MoE "
                         "layer (--spmd ep; default 2)")
+    p.add_argument("--seq-parallel", type=int, default=None,
+                   help="seq-axis size for --spmd sp (mesh becomes "
+                        "{data: N/sp, seq: sp}; the LM runs ring attention "
+                        "with the sequence sharded across it; defaults to "
+                        "all devices)")
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--wandb", action="store_true", help="log to Weights & Biases")
     # manual cluster bring-up (CPU fake cluster / debugging)
@@ -167,26 +172,51 @@ def main(argv=None) -> int:
         )
     if args.final_eval and args.val_dataset is None:
         raise SystemExit("--final-eval needs --val-dataset")
+    def data_x_mesh(axis: str, flag: str, requested, min_k: int = 2):
+        """The shared {data: N/k, <axis>: k} mesh recipe behind --tp /
+        --pipe / --expert-parallel / --seq-parallel: resolve the default
+        (all devices), validate divisibility, build the mesh."""
+        from fluxdistributed_tpu.mesh import make_mesh
+
+        ndev = jax.device_count()
+        k = requested if requested is not None else ndev
+        if k < min_k or ndev % k:
+            raise SystemExit(
+                f"{flag} {k} must be >={min_k} and divide {ndev} devices")
+        return make_mesh({"data": ndev // k, axis: k}), k
+
+    # Sequence/context parallelism: the model's attn_fn closes over the
+    # mesh, so the seq mesh is built BEFORE the model for this mode
+    sp_mesh = None
+    sp_kwargs = {}
+    if args.spmd == "sp":
+        from fluxdistributed_tpu.parallel import make_ring_attention
+
+        if not is_lm:
+            raise SystemExit("--spmd sp needs an lm_* model (causal ring "
+                             "attention over the sequence)")
+        sp_mesh, sp = data_x_mesh("seq", "--seq-parallel", args.seq_parallel)
+        if args.seqlen % sp:
+            raise SystemExit(f"--seqlen {args.seqlen} must be a multiple of "
+                             f"the seq axis size {sp}")
+        sp_kwargs = {"attn_fn": make_ring_attention(
+            sp_mesh, batch_axis="data", causal=True)}
+
     # MoE expert parallelism: the model's moe_fn closes over the mesh,
     # so the expert mesh is built BEFORE the model for this mode
     ep_mesh = None
     moe_kwargs = {}
     if args.spmd == "ep":
-        from fluxdistributed_tpu.mesh import make_mesh
         from fluxdistributed_tpu.parallel.ep import moe_apply
 
         if not is_lm:
             raise SystemExit("--spmd ep needs an lm_* model (MoE blocks)")
-        ndev = jax.device_count()
-        ep = args.expert_parallel if args.expert_parallel is not None else ndev
-        if ep < 2 or ndev % ep:
-            raise SystemExit(f"--expert-parallel {ep} must be >=2 and divide "
-                             f"{ndev} devices")
+        ep_mesh, ep = data_x_mesh(
+            "expert", "--expert-parallel", args.expert_parallel)
         nex = args.experts if args.experts is not None else ep
         if nex % ep:
             raise SystemExit(f"--experts {nex} must be a multiple of the "
                              f"expert axis size {ep}")
-        ep_mesh = make_mesh({"data": ndev // ep, "expert": ep})
         moe_kwargs = {
             "moe_every": args.moe_every if args.moe_every is not None else 2,
             "num_experts": nex,
@@ -201,7 +231,7 @@ def main(argv=None) -> int:
         # metrics; cycles must be explicit (the text stream is unbounded).
         # Pipeline modes build their own per-microbatch loss — passing a
         # loss_fn there is an error by design (trainer raises).
-        model = model_fn(vocab=args.vocab, **moe_kwargs)
+        model = model_fn(vocab=args.vocab, **moe_kwargs, **sp_kwargs)
         if args.spmd in ("pp", "pp_1f1b"):
             lm_extra = {"topk": ()}
         else:
@@ -232,31 +262,24 @@ def main(argv=None) -> int:
             or args.moe_every is not None) and args.spmd != "ep":
         raise SystemExit(
             "--expert-parallel/--experts/--moe-every only apply with --spmd ep")
+    if args.seq_parallel is not None and args.spmd != "sp":
+        raise SystemExit("--seq-parallel only applies with --spmd sp")
     if args.spmd in ("tp", "fsdp_tp"):
-        from fluxdistributed_tpu.mesh import make_mesh
-
-        ndev = jax.device_count()
-        if args.spmd == "fsdp_tp" and (args.tp is None or args.tp >= ndev):
+        if args.spmd == "fsdp_tp" and (
+                args.tp is None or args.tp >= jax.device_count()):
             raise SystemExit(
                 "--spmd fsdp_tp needs --tp < device count: with no data-axis "
                 "extent there is nothing for FSDP to shard over"
             )
-        tp = args.tp if args.tp is not None else ndev
-        if tp < 1 or ndev % tp:
-            raise SystemExit(f"--tp {tp} must be >=1 and divide {ndev} devices")
-        mesh = make_mesh({"data": ndev // tp, "model": tp})
+        mesh, _ = data_x_mesh("model", "--tp", args.tp, min_k=1)
     elif args.spmd in ("pp", "pp_1f1b"):
-        from fluxdistributed_tpu.mesh import make_mesh
-
-        ndev = jax.device_count()
-        pipe = args.pipe if args.pipe is not None else ndev
-        if pipe < 2 or ndev % pipe:
-            raise SystemExit(f"--pipe {pipe} must be >=2 and divide {ndev} devices")
-        mesh = make_mesh({"data": ndev // pipe, "pipe": pipe})
+        mesh, _ = data_x_mesh("pipe", "--pipe", args.pipe)
         lm_extra["num_microbatches"] = args.microbatches
         lm_extra["pipeline_interleave"] = args.pp_interleave
     elif args.spmd == "ep":
         mesh = ep_mesh
+    elif args.spmd == "sp":
+        mesh = sp_mesh
     else:
         mesh = fd.data_mesh()
     if multihost.is_coordinator():

@@ -159,16 +159,21 @@ def prepare_training(
             "schedule; GPipe-via-AD cannot interleave)")
     mesh = mesh or mesh_lib.data_mesh()
     init_draw = None
+    # a data-axis-divisible init sample for the modes whose models
+    # contain a mesh-bound shard_map (ring attention, MoE dispatch) —
+    # those execute it during init, and a batch of 1 cannot shard over
+    # a >1 data axis.  Other modes keep the cheap single-sample init.
+    ninit = mesh.shape.get(mesh_lib.DATA_AXIS, 1) if spmd in ("sp", "ep") else 1
     if input_shape is not None:
-        dummy = np.zeros((1, *input_shape), np.float32)
+        dummy = np.zeros((ninit, *input_shape), np.float32)
     else:
-        # draw one real sample so init sees the dataset's true shape AND
+        # draw real samples so init sees the dataset's true shape AND
         # dtype (f32 images, int32 tokens, ...); kept for the pp_1f1b
         # mask probe below so startup draws only once
         from ..data.loader import model_input
 
         init_draw = apply_transform(
-            transform, dataset.batch(np.random.default_rng(0), 1))
+            transform, dataset.batch(np.random.default_rng(0), ninit))
         dummy = model_input(init_draw)
 
     p_rng, d_rng = jax.random.split(jax.random.PRNGKey(seed))
@@ -388,7 +393,7 @@ def prepare_training(
         state = jax.tree.map(jax.device_put, state, sh)
         step_fn = make_train_step(
             loss_fn, optimizer, mesh, axis=mesh_lib.DATA_AXIS,
-            donate=donate, state_shardings=sh,
+            donate=donate, seed=seed, state_shardings=sh,
         )
         eval_fn = make_eval_step(loss_fn, mesh, topk=(), state_shardings=sh)
     elif spmd == "fsdp":
@@ -403,6 +408,25 @@ def prepare_training(
         )
         eval_fn = fsdp_lib.make_eval_step_fsdp(loss_fn, mesh, specs, topk=tuple(topk))
     else:
+        if spmd not in ("jit", "shard_map", "sp"):
+            raise ValueError(
+                f"unknown spmd mode {spmd!r}; pick one of jit / shard_map / "
+                "fsdp / tp / fsdp_tp / pp / pp_1f1b / ep / sp"
+            )
+        if spmd == "sp":
+            # sequence/context parallelism rides the plain jit path with
+            # REPLICATED params: the model's mesh-bound attn_fn (ring /
+            # Ulysses, parallel/context.py) shards the sequence dim over
+            # the 'seq' axis inside its own shard_map, and the batch
+            # stays data-sharded.  Only the mesh shape needs checking.
+            for ax in ("seq", mesh_lib.DATA_AXIS):
+                if ax not in mesh.shape:
+                    raise ValueError(
+                        "spmd='sp' needs a mesh with 'data' and 'seq' axes, "
+                        "e.g. make_mesh({'data': 1, 'seq': 8}), and a model "
+                        "built with attn_fn=make_ring_attention(mesh, "
+                        "batch_axis='data', ...)"
+                    )
         if spmd == "shard_map":
             if accum_steps != 1:
                 raise ValueError("accum_steps > 1 requires spmd='jit'")
