@@ -49,7 +49,14 @@ def main():
     ap.add_argument("--vocab", type=int, default=512)
     ap.add_argument("--seconds", type=float, default=2.0)
     ap.add_argument("--schedule", choices=("gpipe", "1f1b"), default="gpipe")
+    ap.add_argument("--remat", action="store_true",
+                    help="gpipe only: lm_pp(remat=True) — per-tick input "
+                         "checkpointing, the AD-side answer to the residual "
+                         "blowup (compare against the 1f1b rows)")
     args = ap.parse_args()
+    if args.remat and args.schedule != "gpipe":
+        ap.error("--remat applies to --schedule gpipe only (1f1b always "
+                 "recomputes from its input ring)")
 
     import jax
 
@@ -95,7 +102,8 @@ def main():
                 return run(p["stages"], p["outer"], t, t)
 
         else:
-            split_params, loss_fn, _ = lm_pp(model, mesh, num_microbatches=M)
+            split_params, loss_fn, _ = lm_pp(
+                model, mesh, num_microbatches=M, remat=args.remat)
             pp = split_params(params)
 
             @jax.jit
@@ -132,7 +140,8 @@ def main():
         print(json.dumps(rows[-1]), flush=True)
 
     print(json.dumps({
-        "metric": f"{args.schedule} pipeline: measured vs (S-1)/(M+S-1)",
+        "metric": f"{args.schedule}{'-remat' if args.remat else ''} "
+                  "pipeline: measured vs (S-1)/(M+S-1)",
         "platform": jax.devices()[0].platform,
         "rows": rows,
     }))
