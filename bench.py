@@ -277,6 +277,14 @@ def main():
             out.setdefault("probe_logs", {})[os.path.basename(log)] = {
                 "lines": len(lines), "last": lines[-1][:200],
             }
+            # flat legacy keys (pre-r4 schema) kept alongside probe_logs
+            # for one round so older verdict tooling keeps parsing.
+            # Deliberately first-log-found: watch.log (canonical watcher
+            # evidence) when present, else the r4 probe log — present
+            # whenever ANY probe evidence exists.  "attempts" is
+            # historically a raw line count, not parsed attempt rows.
+            out.setdefault("probe_attempts", len(lines))
+            out.setdefault("probe_last", lines[-1][:200])
     print(json.dumps(out))
 
 

@@ -42,12 +42,65 @@ if [ "$(date +%s)" -ge "$(( DEADLINE - 2400 ))" ]; then
     exit 0
 fi
 
-# wait for any in-flight bench client (grant contention wedges init);
-# the .stop kill file is honored here too, or a wedged client would
-# make the watcher ignore stop requests forever
-while pgrep -f "bench\.py --one" > /dev/null 2>&1; do
+# wait for any in-flight TPU client (grant contention wedges init);
+# covers bench.py, every hw_session stage, manually launched
+# benchmarks/*.py and bin/*.py clients — by any path (absolute,
+# repo-relative, cwd-relative) and through interpreter flags
+# ("python -u script.py") or module form ("python -m pkg.mod").
+# Detection extracts the actual SCRIPT token of each live python
+# interpreter and compares its basename against the script sets
+# derived from benchmarks/ and bin/ at startup, so (a) a shell,
+# editor, or pytest run whose argv merely mentions these names never
+# counts, and (b) new benchmark scripts are covered without editing
+# this list.  The .stop kill file is honored in the wait loop too, or
+# a wedged client would make the watcher ignore stop requests forever
+tpu_client_inflight() {
+    # rebuilt each call (runs once/min) so scripts added mid-watch count
+    _known="bench.py $(ls benchmarks/*.py bin/*.py 2>/dev/null | sed 's|.*/||' | tr '\n' ' ')"
+    for _pid in $(pgrep -f "^([^ ]*/)?python[0-9.]*( |$)" 2>/dev/null); do
+        _args=$(ps -o args= -p "$_pid" 2>/dev/null) || continue
+        # first non-flag token after the interpreter = the script;
+        # "-m pkg.mod" maps to pkg/mod.py so module launches count too;
+        # -W/-X/-Q consume a separate argument, skip it
+        _script=""
+        _want_mod=0
+        _skip=0
+        set -- $_args
+        shift
+        for _tok in "$@"; do
+            if [ "$_skip" = 1 ]; then _skip=0; continue; fi
+            if [ "$_want_mod" = 1 ]; then
+                _script="$(printf %s "$_tok" | tr '.' '/').py"
+                break
+            fi
+            case "$_tok" in
+                -m) _want_mod=1 ;;
+                -W|-X|-Q|--check-hash-based-pycs) _skip=1 ;;
+                -c) break ;;
+                -*) ;;
+                *) _script="$_tok"; break ;;
+            esac
+        done
+        [ -n "$_script" ] || continue
+        _base="${_script##*/}"
+        case "$_base" in
+            test_*|conftest.py) continue ;;        # pytest files never hold the grant
+        esac
+        for _k in $_known; do
+            [ "$_base" = "$_k" ] && return 0
+        done
+    done
+    return 1
+}
+while tpu_client_inflight; do
     if [ -e "$OUT/.stop" ]; then
         echo "[$(stamp)] watch: stop file present while waiting; exiting"
+        exit 0
+    fi
+    # a long-lived matched client (e.g. bin/serve.py) must not make the
+    # watcher outlive its deadline while holding the flock
+    if [ "$(date +%s)" -ge "$(( DEADLINE - 2400 ))" ]; then
+        echo "[$(stamp)] watch: deadline reached while waiting; exiting to free the slot"
         exit 0
     fi
     echo "[$(stamp)] watch: waiting for in-flight bench client"
