@@ -58,6 +58,12 @@ fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqle
 fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 2048 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
 fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_medium --seqlen 1024 --batch 8 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
 fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_medium --seqlen 1024 --batch 8 --remat >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
+# Pallas flash fwd+bwd vs XLA blockwise through the FULL train step at
+# long T (VERDICT r4 #3: the kernel must earn its keep on hardware)
+fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 2048 --attn flash >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
+fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 2048 --attn blockwise >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
+fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 4096 --batch 8 --attn flash >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
+fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 4096 --batch 8 --attn blockwise >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
 
 echo "[$(stamp)] 6/7 end-to-end ingest" | tee -a "$OUT/session.log"
 fits 3600 && timeout 3600 python benchmarks/ingest_e2e.py --steps 20 >> "$OUT/ingest.jsonl" 2>> "$OUT/session.log"

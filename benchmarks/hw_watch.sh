@@ -82,6 +82,11 @@ tpu_client_inflight() {
             esac
         done
         [ -n "$_script" ] || continue
+        # CPU-pinned runs (test-suite driver children, --platform cpu
+        # smoke benches) never hold the TPU grant
+        case " $_args" in
+            *" --platform cpu"*|*"--platform=cpu"*) continue ;;
+        esac
         _base="${_script##*/}"
         case "$_base" in
             test_*|conftest.py) continue ;;        # pytest files never hold the grant

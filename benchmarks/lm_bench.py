@@ -31,6 +31,11 @@ def main():
     ap.add_argument("--batch", type=int, default=0,
                     help="global batch (sequences); 0 = 8/chip on TPU, 2/device on CPU")
     ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--attn", default="dense",
+                    choices=["dense", "blockwise", "flash"],
+                    help="attention core: XLA dense, XLA blockwise, or the "
+                         "Pallas flash kernel (fwd AND bwd)")
+    ap.add_argument("--attn-block", type=int, default=128)
     ap.add_argument("--seconds", type=float, default=2.0)
     ap.add_argument("--platform", default=None, help="force platform (e.g. cpu)")
     args = ap.parse_args()
@@ -49,7 +54,20 @@ def main():
     batch = args.batch or (8 if platform == "tpu" else 2) * nchips
 
     mesh = mesh_lib.data_mesh()
-    model = getattr(models, args.model)(vocab=args.vocab, remat=args.remat)
+    import functools
+
+    attn_fn = None
+    if args.attn == "blockwise":
+        from fluxdistributed_tpu.ops.attention import blockwise_attention
+        attn_fn = functools.partial(
+            blockwise_attention, block_size=args.attn_block, causal=True)
+    elif args.attn == "flash":
+        from fluxdistributed_tpu.ops.pallas_attention import flash_attention
+        attn_fn = functools.partial(
+            flash_attention, causal=True,
+            block_q=args.attn_block, block_k=args.attn_block)
+    model = getattr(models, args.model)(
+        vocab=args.vocab, remat=args.remat, attn_fn=attn_fn)
     rng = np.random.default_rng(0)
     toks = rng.integers(0, args.vocab, (batch, args.seqlen)).astype(np.int32)
     params = model.init(jax.random.PRNGKey(0), toks[:1], train=False)["params"]

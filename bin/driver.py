@@ -100,6 +100,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe-every", type=int, default=None,
                    help="route every K-th decoder block through the MoE "
                         "layer (--spmd ep; default 2)")
+    p.add_argument("--sp-strategy", default="ring",
+                   choices=["ring", "ulysses"],
+                   help="context-parallel attention for --spmd sp: 'ring' "
+                        "(ppermute KV rotation, O(T/P) memory, any head "
+                        "count) or 'ulysses' (two all_to_alls re-shard "
+                        "seq<->heads; needs num_heads %% seq-axis == 0)")
     p.add_argument("--seq-parallel", type=int, default=None,
                    help="seq-axis size for --spmd sp (mesh becomes "
                         "{data: N/sp, seq: sp}; the LM runs ring attention "
@@ -190,16 +196,30 @@ def main(argv=None) -> int:
     sp_mesh = None
     sp_kwargs = {}
     if args.spmd == "sp":
-        from fluxdistributed_tpu.parallel import make_ring_attention
+        from fluxdistributed_tpu.parallel import (
+            make_ring_attention, make_ulysses_attention,
+        )
 
         if not is_lm:
-            raise SystemExit("--spmd sp needs an lm_* model (causal ring "
-                             "attention over the sequence)")
+            raise SystemExit("--spmd sp needs an lm_* model (causal context-"
+                             "parallel attention over the sequence)")
         sp_mesh, sp = data_x_mesh("seq", "--seq-parallel", args.seq_parallel)
         if args.seqlen % sp:
             raise SystemExit(f"--seqlen {args.seqlen} must be a multiple of "
                              f"the seq axis size {sp}")
-        sp_kwargs = {"attn_fn": make_ring_attention(
+        if args.sp_strategy == "ulysses":
+            # Ulysses re-shards heads over the seq axis: the head count is
+            # a model-constructor default, so probe it before committing.
+            nheads = model_fn(vocab=args.vocab).num_heads
+            if nheads % sp:
+                raise SystemExit(
+                    f"--sp-strategy ulysses needs num_heads ({nheads} for "
+                    f"{args.model}) divisible by the seq axis size {sp}; "
+                    f"use --seq-parallel accordingly or --sp-strategy ring")
+            make_attn = make_ulysses_attention
+        else:
+            make_attn = make_ring_attention
+        sp_kwargs = {"attn_fn": make_attn(
             sp_mesh, batch_axis="data", causal=True)}
 
     # MoE expert parallelism: the model's moe_fn closes over the mesh,
@@ -264,6 +284,8 @@ def main(argv=None) -> int:
             "--expert-parallel/--experts/--moe-every only apply with --spmd ep")
     if args.seq_parallel is not None and args.spmd != "sp":
         raise SystemExit("--seq-parallel only applies with --spmd sp")
+    if args.sp_strategy != "ring" and args.spmd != "sp":
+        raise SystemExit("--sp-strategy only applies with --spmd sp")
     if args.spmd in ("tp", "fsdp_tp"):
         if args.spmd == "fsdp_tp" and (
                 args.tp is None or args.tp >= jax.device_count()):

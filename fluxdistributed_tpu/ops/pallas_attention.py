@@ -1,4 +1,4 @@
-"""Pallas TPU flash-attention kernel.
+"""Pallas TPU flash-attention kernel (forward AND backward).
 
 Net-new TPU scope (the reference has no attention and no custom kernels;
 its native compute all comes from CUDNN via dependencies — SURVEY §2
@@ -9,17 +9,29 @@ materializes the [Tq, Tk] score matrix in HBM.
 
 Design (standard TPU flash schedule):
 
-* grid = (batch*heads, Tq/block_q, Tk/block_k), KV innermost — the TPU
-  grid is sequential per core, so VMEM scratch (acc, m, l) carries the
-  online-softmax state across the KV dimension;
+* forward grid = (batch*heads, Tq/block_q, Tk/block_k), KV innermost —
+  the TPU grid is sequential per core, so VMEM scratch (acc, m, l)
+  carries the online-softmax state across the KV dimension; the kernel
+  also emits the per-row logsumexp (LSE) so the backward can recompute
+  the block softmax without a second online pass;
 * Q/K/V blocks are DMA'd HBM→VMEM by ``pallas_call`` per the BlockSpecs;
   the two matmuls (q·kᵀ and p·v) hit the MXU with f32 accumulation;
 * causal masking uses global positions; fully-masked KV blocks are
   skipped with ``pl.when`` (no MXU work);
-* backward: ``jax.custom_vjp`` recomputes via the XLA blockwise kernel
-  (memory-bounded; a dedicated Pallas backward is future work).
+* backward = two dedicated Pallas kernels (FlashAttention-2 schedule):
+  - dQ kernel, grid (BH, Tq/bq, Tk/bk) with KV innermost: recomputes
+    p = exp(s − LSE) per tile, folds dS·K into a VMEM f32 accumulator,
+    writes dQ once on the last KV step;
+  - dK/dV kernel, grid (BH, Tk/bk, Tq/bq) with Q innermost: same tile
+    recompute, accumulates Pᵀ·dO and dSᵀ·Q in VMEM, writes dK/dV once
+    on the last Q step.
+  ``delta = rowsum(dO ∘ O)`` is a cheap XLA elementwise-reduce done
+  outside the kernels.  Padded query rows are self-masking: their LSE is
+  padded to +1e30 so exp(s − LSE) is exactly 0.  Padded key rows are
+  zero, so their dQ contribution (dS·K) vanishes without a mask; their
+  dK/dV rows are garbage that the caller slices off.
 
-On non-TPU backends the same kernel runs in interpreter mode, so tests
+On non-TPU backends the same kernels run in interpreter mode, so tests
 exercise identical code on the CPU CI mesh.
 """
 
@@ -32,16 +44,20 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .attention import NEG_INF, blockwise_attention, online_softmax_update
+from .attention import NEG_INF, online_softmax_update
 
 __all__ = ["flash_attention"]
 
 # m/l scratch rows are replicated across the VPU lane width.
 _LANES = 128
+# LSE pad value for rows beyond Tq: exp(s - 1e30) == 0, so padded query
+# rows contribute exactly nothing to dK/dV (and can never produce inf*0
+# NaNs the way a garbage LSE could).
+_LSE_PAD = 1e30
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, scale, causal, tk_valid, causal_offset, padded,
 ):
     """``causal_offset = Tk_valid - Tq_valid`` end-aligns the causal mask
@@ -98,6 +114,131 @@ def _flash_kernel(
     def _finalize():
         l = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        # LSE of a fully-masked row is ~NEG_INF; its backward tiles are
+        # all-masked anyway, so the value is never observed.
+        lse_ref[0] = m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30))
+
+
+def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+              *, scale, causal, tk_valid, causal_offset, padded,
+              q_start, k_start):
+    """Shared dQ/dKV tile recompute: returns (p, ds), both [bq, bk] f32.
+
+    ``p`` is the exact forward block softmax, rebuilt from LSE;
+    ``ds = p * (dP - delta)`` is the score gradient.  Masked positions
+    are zeroed in ``p`` (NEG_INF-before-exp alone is unsafe: a fully-
+    masked row has LSE ~ NEG_INF, making exp(s - LSE) explode).  Padded
+    K columns are re-masked too: their K rows are zero so a FINITE p
+    contributes nothing to dQ, but their score is 0 and exp(0 - LSE)
+    can overflow to inf when a row's LSE < ~-88, and inf · 0 = NaN.
+    """
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    s = scale * jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [block_q, block_k]
+    p = jnp.exp(s - lse[:, None])
+    if causal or padded:
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = k_pos < tk_valid
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            mask &= k_pos <= q_pos + causal_offset
+        p = jnp.where(mask, p, 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [block_q, block_k]
+    ds = p * (dp - delta[:, None])
+    return p, ds
+
+
+def _flash_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc_ref,
+    *, scale, causal, tk_valid, causal_offset, padded,
+):
+    _, block_q, _ = q_ref.shape
+    _, block_k, _ = k_ref.shape
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    q_start = qi * block_q
+    k_start = kj * block_k
+
+    def _body():
+        _, ds = _bwd_tile(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            scale=scale, causal=causal, tk_valid=tk_valid,
+            causal_offset=causal_offset, padded=padded,
+            q_start=q_start, k_start=k_start,
+        )
+        dq_acc_ref[:] += scale * jax.lax.dot_general(
+            ds, k_ref[0].astype(jnp.float32),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(k_start <= q_start + block_q - 1 + causal_offset)(_body)
+    else:
+        _body()
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc_ref, dv_acc_ref,
+    *, scale, causal, tk_valid, causal_offset, padded,
+):
+    _, block_q, _ = q_ref.shape
+    _, block_k, _ = k_ref.shape
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    q_start = qi * block_q
+    k_start = kj * block_k
+
+    def _body():
+        p, ds = _bwd_tile(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            scale=scale, causal=causal, tk_valid=tk_valid,
+            causal_offset=causal_offset, padded=padded,
+            q_start=q_start, k_start=k_start,
+        )
+        dv_acc_ref[:] += jax.lax.dot_general(
+            p, do_ref[0].astype(jnp.float32),
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )  # pᵀ·dO: contract over the q dimension → [block_k, d]
+        dk_acc_ref[:] += scale * jax.lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32),
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )  # dSᵀ·Q → [block_k, d]
+
+    if causal:
+        pl.when(k_start <= q_start + block_q - 1 + causal_offset)(_body)
+    else:
+        _body()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
 def _pad_seq(x, block):
@@ -105,6 +246,16 @@ def _pad_seq(x, block):
     if pad:
         x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
     return x
+
+
+def _fold(x):
+    """[B, T, H, D] → [B*H, T, D] (the kernels' layout)."""
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _unfold(x, b, h, t):
+    return x[:, :t].reshape(b, h, t, x.shape[-1]).transpose(0, 2, 1, 3)
 
 
 @functools.partial(
@@ -118,10 +269,9 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     block_k = min(block_k, tk)
 
     # Fold heads into batch: kernel operates on [BH, T, D].
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-    qf = _pad_seq(fold(q), block_q)
-    kf = _pad_seq(fold(k), block_k)
-    vf = _pad_seq(fold(v), block_k)
+    qf = _pad_seq(_fold(q), block_q)
+    kf = _pad_seq(_fold(k), block_k)
+    vf = _pad_seq(_fold(v), block_k)
     tq_p, tk_p = qf.shape[1], kf.shape[1]
 
     grid = (b * h, tq_p // block_q, tk_p // block_k)
@@ -129,7 +279,7 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
         _flash_kernel, scale=scale, causal=causal, tk_valid=tk,
         causal_offset=tk - tq, padded=tk_p != tk,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -137,8 +287,14 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq_p), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -146,7 +302,82 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out[:, :tq].reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    return _unfold(out, b, h, tq), lse[:, :tq]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / (d**0.5)
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+
+    qf = _pad_seq(_fold(q), block_q)
+    kf = _pad_seq(_fold(k), block_k)
+    vf = _pad_seq(_fold(v), block_k)
+    dof = _pad_seq(_fold(g), block_q)
+    of = _pad_seq(_fold(o), block_q)
+    tq_p, tk_p = qf.shape[1], kf.shape[1]
+
+    # delta_i = Σ_d dO ∘ O — one XLA fusion; zero on padded rows (dO pad).
+    delta = (dof.astype(jnp.float32) * of.astype(jnp.float32)).sum(-1)
+    lse_p = jnp.pad(
+        lse, ((0, 0), (0, tq_p - tq)), constant_values=_LSE_PAD
+    )
+
+    nq, nk = tq_p // block_q, tk_p // block_k
+    bh = b * h
+    q_spec_i = pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0))
+    kv_spec_j = pl.BlockSpec((1, block_k, d), lambda bh_, i, j: (bh_, j, 0))
+    row_spec_i = pl.BlockSpec((1, block_q), lambda bh_, i, j: (bh_, i))
+    # dKV grid is (bh, j, i): q-indexed operands follow the INNER axis.
+    q_spec_inner = pl.BlockSpec((1, block_q, d), lambda bh_, j, i: (bh_, i, 0))
+    kv_spec_outer = pl.BlockSpec((1, block_k, d), lambda bh_, j, i: (bh_, j, 0))
+    row_spec_inner = pl.BlockSpec((1, block_q), lambda bh_, j, i: (bh_, i))
+
+    common = dict(
+        scale=scale, causal=causal, tk_valid=tk, causal_offset=tk - tq,
+        padded=tk_p != tk,
+    )
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, **common),
+        grid=(bh, nq, nk),
+        in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i,
+                  row_spec_i, row_spec_i],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse_p, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, **common),
+        grid=(bh, nk, nq),
+        in_specs=[q_spec_inner, kv_spec_outer, kv_spec_outer, q_spec_inner,
+                  row_spec_inner, row_spec_inner],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh_, j, i: (bh_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, j, i: (bh_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk_p, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk_p, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse_p, delta)
+
+    return (
+        _unfold(dq, b, h, tq),
+        _unfold(dk, b, h, tk),
+        _unfold(dv, b, h, tk),
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -160,29 +391,28 @@ def flash_attention(
 ) -> jax.Array:
     """Fused flash attention, [B, T, H, D] → [B, T, H, D].
 
-    Runs the Pallas TPU kernel on TPU and the same kernel under the
-    Pallas interpreter elsewhere (so CPU tests cover the real kernel).
-    Numerics match ``dot_product_attention`` to f32 accumulation.
+    Runs the Pallas TPU kernels on TPU and the same kernels under the
+    Pallas interpreter elsewhere (so CPU tests cover the real kernels),
+    forward and backward.  Numerics match ``dot_product_attention`` to
+    f32 accumulation.
     """
     interpret = jax.default_backend() != "tpu"
-    return _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _fwd(q, k, v, causal, block_q, block_k):
-    return flash_attention(q, k, v, causal, block_q, block_k), (q, k, v)
+    interpret = jax.default_backend() != "tpu"
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, block_q, block_k, res, g):
-    q, k, v = res
-    # Memory-bounded recompute backward via the XLA blockwise kernel
-    # (identical online-softmax numerics to the forward).
-    _, vjp = jax.vjp(
-        lambda q, k, v: blockwise_attention(
-            q, k, v, block_size=block_k, causal=causal
-        ),
-        q, k, v,
+    q, k, v, o, lse = res
+    interpret = jax.default_backend() != "tpu"
+    return _flash_bwd_impl(
+        q, k, v, o, lse, g, causal, block_q, block_k, interpret
     )
-    return vjp(g)
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import os
 import re
+import threading
 from typing import Any, Optional
 
 import jax
@@ -36,8 +37,16 @@ _STEP_RE = re.compile(r"^step_(\d+)$")
 
 # Checkpointers with an async write still in flight (block=False saves).
 # At most one at a time: save_checkpoint drains it before starting the
-# next, and train()/callers drain at exit via wait_for_pending().
+# next, and train()/callers drain at exit via wait_for_pending().  The
+# expected owner is a single train loop per process; the locks make a
+# stray second caller (e.g. an eval thread saving best-so-far)
+# serialize instead of corrupting the drain: _PENDING_LOCK protects the
+# list, _SAVE_LOCK spans a whole save (drain → write → append) so two
+# concurrent saves cannot both observe an empty pending list and race
+# their rmtree/write phases.
 _PENDING: list = []
+_PENDING_LOCK = threading.Lock()
+_SAVE_LOCK = threading.Lock()
 
 
 def _step_dir(directory: str, step: int) -> str:
@@ -52,9 +61,10 @@ def wait_for_pending() -> None:
     reference is removed only after a successful wait, so a failed wait
     leaves it in place and a retry can still await the write.
     """
-    while _PENDING:
-        _PENDING[-1].wait_until_finished()
-        _PENDING.pop()
+    with _PENDING_LOCK:
+        while _PENDING:
+            _PENDING[-1].wait_until_finished()
+            _PENDING.pop()
 
 
 def save_checkpoint(
@@ -74,23 +84,25 @@ def save_checkpoint(
     on the coordinator only, behind a barrier — concurrent ``rmtree`` from
     N hosts on a shared filesystem would race the save.
     """
-    wait_for_pending()  # one in-flight save at a time
-    path = _step_dir(directory, step)
-    ckptr = ocp.StandardCheckpointer()
-    if overwrite and os.path.exists(path):
-        if jax.process_index() == 0:
-            import shutil
+    with _SAVE_LOCK:  # one save (drain → write → append) at a time
+        wait_for_pending()
+        path = _step_dir(directory, step)
+        ckptr = ocp.StandardCheckpointer()
+        if overwrite and os.path.exists(path):
+            if jax.process_index() == 0:
+                import shutil
 
-            shutil.rmtree(path, ignore_errors=True)
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
+                shutil.rmtree(path, ignore_errors=True)
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
 
-            multihost_utils.sync_global_devices("ckpt_rmtree")
-    ckptr.save(path, state)
-    if block:
-        ckptr.wait_until_finished()
-    else:
-        _PENDING.append(ckptr)
+                multihost_utils.sync_global_devices("ckpt_rmtree")
+        ckptr.save(path, state)
+        if block:
+            ckptr.wait_until_finished()
+        else:
+            with _PENDING_LOCK:
+                _PENDING.append(ckptr)
     return path
 
 

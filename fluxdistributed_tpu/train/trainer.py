@@ -26,6 +26,7 @@ parity points, with their reference anchors:
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import time
 from typing import Any, Callable, Iterable, Optional, Sequence
@@ -77,6 +78,24 @@ class TrainTask:
     # by default so a mode that compiles loss-only eval (the LM
     # pipelines) needs no caller-side coordination
     topk: tuple = (1, 5, 10)
+
+
+def _eval_view(dataset):
+    """A non-mutating eval view of ``dataset``: same tables/decoders,
+    augmentation off.
+
+    Eval draws must go through the eval pipeline even when the dataset
+    augments its train split — but toggling ``dataset.augment`` in place
+    (the old scheme) races a concurrent prefetch loader sharing the
+    object, which would silently draw un-augmented TRAIN batches while
+    an eval runs.  A shallow copy gives the eval path its own ``augment``
+    flag while sharing the (read-only) sample tables underneath.
+    """
+    if getattr(dataset, "augment", False):
+        view = copy.copy(dataset)
+        view.augment = False
+        return view
+    return dataset
 
 
 def prepare_training(
@@ -467,18 +486,11 @@ def prepare_training(
         q = batch_quantum or mesh.shape[mesh_lib.DATA_AXIS]
         nval = max(q, (val_samples // q) * q)
         # Validation must go through the eval pipeline even when the val
-        # dataset was carved from an augmenting train table — force train
-        # augmentation off for this draw.
-        was_augment = getattr(val_dataset, "augment", False)
-        if was_augment:
-            val_dataset.augment = False
-        try:
-            vdraw = apply_transform(
-                transform, val_dataset.batch(np.random.default_rng(seed + 1), nval)
-            )
-        finally:
-            if was_augment:
-                val_dataset.augment = True
+        # dataset was carved from an augmenting train table.
+        vdraw = apply_transform(
+            transform,
+            _eval_view(val_dataset).batch(np.random.default_rng(seed + 1), nval),
+        )
         from ..data.loader import batch_to_dict
 
         val_batch = sharding_lib.shard_batch(
@@ -636,47 +648,43 @@ def evaluate(
     # a caller-truncated run is a sampled estimate of a different kind
     exact = capable and max_batches == full_batches
     rng = np.random.default_rng(seed)
-    was_augment = getattr(dataset, "augment", False)
-    if was_augment:
-        dataset.augment = False  # eval goes through the eval pipeline
-    try:
-        total = {"loss": 0.0}
-        n = 0
+    # eval goes through the eval pipeline; _eval_view never mutates the
+    # caller's dataset, so a concurrent loader keeps augmenting
+    dataset = _eval_view(dataset)
+    total = {"loss": 0.0}
+    n = 0
 
-        def accumulate(draw, bs, first):
-            nonlocal n
-            draw = apply_transform(task.transform, draw)
-            batch = sharding_lib.shard_batch(
-                batch_to_dict(draw, getattr(dataset, "nclasses", None)), task.mesh
+    def accumulate(draw, bs, first):
+        nonlocal n
+        draw = apply_transform(task.transform, draw)
+        batch = sharding_lib.shard_batch(
+            batch_to_dict(draw, getattr(dataset, "nclasses", None)), task.mesh
+        )
+        loss, accs = task.eval_fn(task.state, batch)
+        if first:
+            _require_topk(accs, topk)
+        total["loss"] += float(loss) * bs
+        for k in topk:
+            total[f"top{k}"] = (
+                total.get(f"top{k}", 0.0) + float(accs[f"top{k}"]) * bs
             )
-            loss, accs = task.eval_fn(task.state, batch)
-            if first:
-                _require_topk(accs, topk)
-            total["loss"] += float(loss) * bs
-            for k in topk:
-                total[f"top{k}"] = (
-                    total.get(f"top{k}", 0.0) + float(accs[f"top{k}"]) * bs
-                )
-            n += bs
+        n += bs
 
-        for i in range(max_batches):
-            if exact:
-                idx = np.arange(i * batch_size, (i + 1) * batch_size)
-                draw = dataset.batch(rng, batch_size, indices=idx)
-            else:
-                draw = dataset.batch(rng, batch_size)
-            accumulate(draw, batch_size, first=i == 0)
-        if exact and rem_size:
-            start = max_batches * batch_size
-            idx = np.arange(start, start + rem_size)
-            # full_batches >= 1 on the exact path, so topk was already
-            # validated by the first full batch
-            accumulate(
-                dataset.batch(rng, rem_size, indices=idx), rem_size, first=False
-            )
-    finally:
-        if was_augment:
-            dataset.augment = True
+    for i in range(max_batches):
+        if exact:
+            idx = np.arange(i * batch_size, (i + 1) * batch_size)
+            draw = dataset.batch(rng, batch_size, indices=idx)
+        else:
+            draw = dataset.batch(rng, batch_size)
+        accumulate(draw, batch_size, first=i == 0)
+    if exact and rem_size:
+        start = max_batches * batch_size
+        idx = np.arange(start, start + rem_size)
+        # full_batches >= 1 on the exact path, so topk was already
+        # validated by the first full batch
+        accumulate(
+            dataset.batch(rng, rem_size, indices=idx), rem_size, first=False
+        )
     out = {key: v / max(n, 1) for key, v in total.items()}
     out["samples"] = n
     out["exact"] = exact

@@ -87,6 +87,105 @@ def test_flash_grads_match_reference():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_non_divisible(causal):
+    """Pallas backward with padded Q and KV blocks (t % block != 0)."""
+    q, k, v = _qkv(t=40)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal, 16, 16) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (dot_product_attention(q, k, v, causal=causal) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_flash_grads_decode_aligned():
+    """Tq != Tk causal backward (end-aligned, the KV-cache convention)."""
+    q, _, _ = _qkv(t=8)
+    q = q[:, :4]
+    _, k, v = _qkv(t=8, seed=1)
+
+    gf = jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v, True, 4, 4) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gr = jax.grad(
+        lambda q, k, v: (dot_product_attention(q, k, v, causal=True) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_flash_grads_fully_masked_rows_finite():
+    """Causal Tq > Tk leaves rows with no attendable key: their output is
+    0, so every grad must be exactly finite (0 for dq rows) — not NaN
+    from exp(s - LSE) with a degenerate LSE."""
+    q8, _, _ = _qkv(t=8, seed=2)
+    _, k4, v4 = _qkv(t=4, seed=3)
+
+    gf = jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v, True, 4, 4) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q8, k4, v4)
+    gr = jax.grad(
+        lambda q, k, v: (dot_product_attention(q, k, v, causal=True) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q8, k4, v4)
+    for a, b in zip(gf, gr):
+        assert np.isfinite(np.asarray(a)).all()
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+    # the first 4 query rows attend nothing → dq exactly 0 there
+    assert np.abs(np.asarray(gf[0][:, :4])).max() == 0.0
+
+
+def test_flash_grads_padded_k_extreme_scores_finite():
+    """Non-causal with padded KV blocks and strongly-repelling q/k: a
+    row whose every real score is << 0 has LSE < -88, where
+    exp(0 - LSE) overflows f32 — the padded K column must be re-masked
+    in the backward or dQ picks up inf·0 = NaN."""
+    q, k, v = _qkv(t=24)  # 24 % 16 != 0 → one padded KV block
+    q = q.at[:, 0].set(q[:, 0] * 0 + 5.0)
+    k = k * 0 - 5.0  # row-0 scores ≈ -5·5·D/sqrt(D) ≈ -141 → LSE < -88
+
+    gf = jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v, False, 16, 16) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gr = jax.grad(
+        lambda q, k, v: (dot_product_attention(q, k, v) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    # |s| ~ 1e2 exaggerates f32 cancellation in exp(s - LSE); the point
+    # here is finiteness plus agreement at a tolerance matching that
+    for a, b in zip(gf, gr):
+        assert np.isfinite(np.asarray(a)).all()
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3)
+
+
+def test_flash_grads_bf16():
+    q, k, v = _qkv(dtype=jnp.bfloat16)
+
+    gf = jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v, False, 16, 16).astype(jnp.float32) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gr = jax.grad(
+        lambda q, k, v: (dot_product_attention(q, k, v).astype(jnp.float32) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(gf, gr):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), rtol=5e-2, atol=5e-2
+        )
+
+
 def test_flash_in_vit():
     """ViT wired with the Pallas kernel == ViT with XLA attention."""
     from functools import partial

@@ -194,6 +194,40 @@ def test_val_batch_sampled_without_augmentation(mesh):
     assert val.augment is True  # and the flag was restored
 
 
+def test_evaluate_never_mutates_shared_augment_flag(mesh):
+    """evaluate() must not toggle ``dataset.augment`` in place: a
+    concurrent prefetch loader sharing the object would silently draw
+    un-augmented TRAIN batches mid-eval.  The eval path gets a shallow
+    view instead; the original's flag stays True THROUGHOUT the eval,
+    not just after it."""
+    from fluxdistributed_tpu.train import evaluate
+
+    observed = []
+
+    class SharedAugDataset(SyntheticDataset):
+        def __init__(self):
+            super().__init__(nsamples=32, nclasses=4, shape=(8, 8, 3))
+            self.augment = True
+
+        def batch(self, rng, n, indices=None):
+            # what a concurrent loader holding the ORIGINAL object would
+            # see at this moment (note: reads the outer object, not self)
+            observed.append(shared.augment)
+            return super().batch(rng, n, indices)
+
+    shared = SharedAugDataset()
+    task = prepare_training(
+        SimpleCNN(num_classes=4), shared, optim.momentum(0.1, 0.9),
+        mesh=mesh, batch_size=8, cycles=1, topk=(1,),
+    )
+    out = evaluate(task, shared, batch_size=16, topk=(1,))
+    assert out["samples"] == 32
+    assert observed and all(observed), (
+        "evaluate() toggled the shared dataset's augment flag in place"
+    )
+    assert shared.augment is True
+
+
 def test_evaluate_whole_dataset(mesh):
     """evaluate() aggregates loss/top-k over the full dataset with the
     compiled eval step; sample counts line up; unbounded streams need

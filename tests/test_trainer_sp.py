@@ -1,8 +1,9 @@
 """Sequence/context parallelism as a trainer mode.
 
 ``spmd="sp"`` rides the plain jit path with replicated params; the
-model's mesh-bound ring attention shards the sequence dimension over
-the ``seq`` axis inside its own shard_map while the batch stays
+model's mesh-bound context-parallel attention (ring or Ulysses — the
+driver's ``--sp-strategy`` flag) shards the sequence dimension over the
+``seq`` axis inside its own shard_map while the batch stays
 data-sharded.  The trainer's job is mesh validation — everything else
 is the standard surface.
 """
@@ -15,18 +16,25 @@ from fluxdistributed_tpu import mesh as mesh_lib, optim
 from fluxdistributed_tpu.data import SyntheticTextDataset
 from fluxdistributed_tpu.models import lm_loss_fn
 from fluxdistributed_tpu.models.transformer_lm import TransformerLM
-from fluxdistributed_tpu.parallel import make_ring_attention
+from fluxdistributed_tpu.parallel import (
+    make_ring_attention,
+    make_ulysses_attention,
+)
 from fluxdistributed_tpu.train import prepare_training
 
 VOCAB = 32
 
+_STRATEGIES = {"ring": make_ring_attention, "ulysses": make_ulysses_attention}
 
-def test_sp_trainer_mode_trains(tmp_path):
+
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+def test_sp_trainer_mode_trains(tmp_path, strategy):
     mesh = mesh_lib.make_mesh({"data": 2, "seq": 4})
+    # Ulysses re-shards heads over the seq axis: 4 heads / seq=4.
     model = TransformerLM(
-        vocab=VOCAB, dim=32, depth=2, num_heads=2, mlp_dim=64,
+        vocab=VOCAB, dim=32, depth=2, num_heads=4, mlp_dim=64,
         dtype=jnp.float32, dropout=0.0,
-        attn_fn=make_ring_attention(mesh, batch_axis="data", causal=True),
+        attn_fn=_STRATEGIES[strategy](mesh, batch_axis="data", causal=True),
     )
     ds = SyntheticTextDataset(vocab=VOCAB, seqlen=32, peak=0.95)
     task = prepare_training(
@@ -56,6 +64,61 @@ def test_sp_mode_rejects_missing_seq_axis():
             mesh=mesh_lib.data_mesh(8), batch_size=16, spmd="sp",
             loss_fn=lm_loss_fn(model), topk=(),
         )
+
+
+def _driver_env():
+    """Child env for bin/driver.py subprocesses: package importable from
+    the repo root, parent's fake-device pin scrubbed (--local-devices
+    sets its own)."""
+    import os
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+@pytest.mark.slow
+def test_driver_cli_ulysses_one_flag(tmp_path):
+    """--spmd sp --sp-strategy ulysses is a one-flag trainer mode:
+    lm_tiny (4 heads) over a {data: 2, seq: 4} mesh, end to end."""
+    import os
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, os.path.join("bin", "driver.py"),
+         "--model", "lm_tiny", "--dataset", "synthetic-text",
+         "--vocab", "32", "--seqlen", "32", "--batch-size", "8",
+         "--cycles", "2", "--opt", "adam", "--lr", "1e-3",
+         "--print-every", "1", "--eval-every", "0",
+         "--spmd", "sp", "--sp-strategy", "ulysses", "--seq-parallel", "4",
+         "--platform", "cpu", "--local-devices", "8"],
+        capture_output=True, text=True, timeout=600, env=_driver_env(),
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert "done: 2 steps" in out.stdout, out.stdout[-2000:]
+
+
+@pytest.mark.slow
+def test_driver_cli_ulysses_head_divisibility_guard():
+    """lm_small has 12 heads: a seq axis of 8 must be rejected up front
+    with an actionable message, not a trace-time assert."""
+    import os
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, os.path.join("bin", "driver.py"),
+         "--model", "lm_small", "--dataset", "synthetic-text",
+         "--seqlen", "64", "--batch-size", "8", "--cycles", "1",
+         "--spmd", "sp", "--sp-strategy", "ulysses",
+         "--platform", "cpu", "--local-devices", "8"],
+        capture_output=True, text=True, timeout=300, env=_driver_env(),
+    )
+    assert out.returncode != 0
+    assert "divisible by the seq axis" in out.stderr, out.stderr[-2000:]
 
 
 def test_unknown_spmd_rejected():
