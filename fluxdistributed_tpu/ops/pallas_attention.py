@@ -46,7 +46,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .attention import NEG_INF, online_softmax_update
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_lse"]
 
 # m/l scratch rows are replicated across the VPU lane width.
 _LANES = 128
@@ -308,7 +308,8 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
 @functools.partial(
     jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
 )
-def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
+def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
+                    g_lse=None):
     b, tq, h, d = q.shape
     tk = k.shape[1]
     scale = 1.0 / (d**0.5)
@@ -324,6 +325,13 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
 
     # delta_i = Σ_d dO ∘ O — one XLA fusion; zero on padded rows (dO pad).
     delta = (dof.astype(jnp.float32) * of.astype(jnp.float32)).sum(-1)
+    if g_lse is not None:
+        # Upstream gradient into the LSE output: ∂lse_r/∂s_rc = p_rc, so
+        # ds = p∘(dP − delta + g_lse) — fold it into delta, the kernels
+        # are untouched.  g_lse: [BH, tq] f32.
+        delta = delta - jnp.pad(
+            g_lse.astype(jnp.float32), ((0, 0), (0, tq_p - tq))
+        )
     lse_p = jnp.pad(
         lse, ((0, 0), (0, tq_p - tq)), constant_values=_LSE_PAD
     )
@@ -416,3 +424,49 @@ def _bwd(causal, block_q, block_k, res, g):
 
 
 flash_attention.defvjp(_fwd, _bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> tuple[jax.Array, jax.Array]:
+    """Flash attention that ALSO returns the per-row logsumexp.
+
+    → ``(out [B, Tq, H, D], lse [B, H, Tq] f32)`` where
+    ``lse = log Σ_k exp(q·kᵀ/√D)``.  The LSE output is differentiable
+    (its gradient folds into the same Pallas backward kernels), which is
+    what lets ring attention use this kernel as its per-hop block
+    compute and combine hops by LSE weighting.  Rows with no attendable
+    position have ``lse ≈ -1e30`` (their combine weight underflows to
+    exactly 0).
+    """
+    interpret = jax.default_backend() != "tpu"
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    b, tq, h, _ = q.shape
+    return out, lse.reshape(b, h, tq)
+
+
+def _fwd_lse(q, k, v, causal, block_q, block_k):
+    interpret = jax.default_backend() != "tpu"
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    b, tq, h, _ = q.shape
+    return (out, lse.reshape(b, h, tq)), (q, k, v, out, lse)
+
+
+def _bwd_lse(causal, block_q, block_k, res, g):
+    q, k, v, o, lse = res
+    g_out, g_lse = g
+    b, tq, h, _ = q.shape
+    interpret = jax.default_backend() != "tpu"
+    return _flash_bwd_impl(
+        q, k, v, o, lse, g_out, causal, block_q, block_k, interpret,
+        g_lse=g_lse.reshape(b * h, tq),
+    )
+
+
+flash_attention_lse.defvjp(_fwd_lse, _bwd_lse)

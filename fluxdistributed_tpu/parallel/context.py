@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import (
+    NEG_INF,
     _scale,
     attn_block_update,
     attn_finalize,
@@ -42,6 +43,7 @@ from ..ops.attention import (
 
 __all__ = [
     "ring_attention",
+    "ring_flash_attention",
     "make_ring_attention",
     "ulysses_attention",
     "make_ulysses_attention",
@@ -91,19 +93,91 @@ def ring_attention(
     return attn_finalize(carry, q.dtype)
 
 
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Ring attention with the Pallas flash kernel as the per-hop block
+    compute.  Call inside ``shard_map``.
+
+    Each hop runs ``flash_attention_lse`` on the locally-resident KV
+    shard (so the [T/P, T/P] score tile lives in VMEM, never HBM) and
+    hops are merged by LSE weighting — the associative normalized-block
+    combine:
+
+        lse' = logaddexp(lse, lse_i)
+        o'   = o·exp(lse − lse') + o_i·exp(lse_i − lse')
+
+    Causality per hop: hop 0 is this device's OWN diagonal KV block →
+    standard causal inside the kernel; hop i>0 holds the KV shard of
+    rank (my_idx − i) mod P, which is either entirely BEFORE the local
+    queries (fully visible, no mask) or entirely AFTER them (wrapped —
+    its combine weight is zeroed).  The predicate is traced, so one
+    compiled program serves every rank, and the FLOPs match the XLA
+    ring (which also computes every hop and masks).
+
+    The hop loop is a Python ``range`` over the static axis size —
+    P pallas_call sites, each reverse-differentiable through
+    ``flash_attention_lse``'s custom VJP.
+    """
+    from ..ops.pallas_attention import flash_attention_lse
+
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+
+    o = jnp.zeros(q.shape, jnp.float32)
+    lse = jnp.full((q.shape[0], q.shape[2], q.shape[1]), NEG_INF, jnp.float32)
+    k_cur, v_cur = k, v
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    for i in range(axis_size):
+        o_i, lse_i = flash_attention_lse(
+            q, k_cur, v_cur, causal and i == 0, block_q, block_k
+        )
+        if causal and i > 0:
+            # KV shard of rank (my_idx - i) mod P: wrapped ranks hold
+            # tokens entirely after the local queries → contribute 0
+            wrapped = my_idx < i
+            lse_i = jnp.where(wrapped, NEG_INF, lse_i)
+        lse_new = jnp.logaddexp(lse, lse_i)
+        # guard the fully-masked-row case: lse_new == NEG_INF would give
+        # exp(0) = 1 weights; keep weights 0 so those rows stay 0
+        w_prev = jnp.where(lse == NEG_INF, 0.0, jnp.exp(lse - lse_new))
+        w_i = jnp.where(lse_i == NEG_INF, 0.0, jnp.exp(lse_i - lse_new))
+        bthd = lambda w: w.transpose(0, 2, 1)[..., None]  # [B,H,T]→[B,T,H,1]
+        o = o * bthd(w_prev) + o_i.astype(jnp.float32) * bthd(w_i)
+        lse = lse_new
+        if i + 1 < axis_size:
+            k_cur, v_cur = jax.lax.ppermute((k_cur, v_cur), axis_name, perm)
+    return o.astype(q.dtype)
+
+
 def make_ring_attention(
     mesh: Mesh,
     seq_axis: str = "seq",
     batch_axis: Optional[str] = None,
     causal: bool = False,
+    impl: str = "xla",
+    block_q: int = 128,
+    block_k: int = 128,
 ):
-    """Wrap ``ring_attention`` in ``shard_map`` → a drop-in ``attn_fn``.
+    """Wrap ring attention in ``shard_map`` → a drop-in ``attn_fn``.
 
     Takes/returns global [B, T, H, D] arrays with T sharded on
     ``seq_axis`` (and optionally B on ``batch_axis``); composes under an
     outer ``jit`` so a ViT built with this attn_fn trains data- AND
-    sequence-parallel from one compiled program.
+    sequence-parallel from one compiled program.  ``impl="flash"`` uses
+    the Pallas kernel per hop (``ring_flash_attention``); ``"xla"`` uses
+    the blockwise online-softmax update.
     """
+    if impl not in ("xla", "flash"):
+        raise ValueError(f"impl must be 'xla' or 'flash', got {impl!r}")
     spec = P(batch_axis, seq_axis)
 
     @partial(
@@ -114,6 +188,11 @@ def make_ring_attention(
         check_vma=False,
     )
     def attn(q, k, v):
+        if impl == "flash":
+            return ring_flash_attention(
+                q, k, v, seq_axis, causal=causal,
+                block_q=block_q, block_k=block_k,
+            )
         return ring_attention(q, k, v, seq_axis, causal=causal)
 
     return attn
