@@ -36,6 +36,8 @@ def main():
                     help="attention core: XLA dense, XLA blockwise, or the "
                          "Pallas flash kernel (fwd AND bwd)")
     ap.add_argument("--attn-block", type=int, default=128)
+    ap.add_argument("--kv-heads", type=int, default=None,
+                    help="grouped-query attention: number of KV heads")
     ap.add_argument("--seconds", type=float, default=2.0)
     ap.add_argument("--platform", default=None, help="force platform (e.g. cpu)")
     args = ap.parse_args()
@@ -54,20 +56,12 @@ def main():
     batch = args.batch or (8 if platform == "tpu" else 2) * nchips
 
     mesh = mesh_lib.data_mesh()
-    import functools
+    from fluxdistributed_tpu.ops import attention_core
 
-    attn_fn = None
-    if args.attn == "blockwise":
-        from fluxdistributed_tpu.ops.attention import blockwise_attention
-        attn_fn = functools.partial(
-            blockwise_attention, block_size=args.attn_block, causal=True)
-    elif args.attn == "flash":
-        from fluxdistributed_tpu.ops.pallas_attention import flash_attention
-        attn_fn = functools.partial(
-            flash_attention, causal=True,
-            block_q=args.attn_block, block_k=args.attn_block)
     model = getattr(models, args.model)(
-        vocab=args.vocab, remat=args.remat, attn_fn=attn_fn)
+        vocab=args.vocab, remat=args.remat,
+        attn_fn=attention_core(args.attn, args.attn_block),
+        num_kv_heads=args.kv_heads)
     rng = np.random.default_rng(0)
     toks = rng.integers(0, args.vocab, (batch, args.seqlen)).astype(np.int32)
     params = model.init(jax.random.PRNGKey(0), toks[:1], train=False)["params"]

@@ -100,6 +100,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe-every", type=int, default=None,
                    help="route every K-th decoder block through the MoE "
                         "layer (--spmd ep; default 2)")
+    p.add_argument("--attn", default="dense",
+                   choices=["dense", "blockwise", "flash"],
+                   help="attention core for lm_* models: XLA dense, XLA "
+                        "blockwise (memory-bounded scan), or the Pallas "
+                        "flash kernel (fused fwd+bwd). Not combinable with "
+                        "--spmd sp, which picks its own context-parallel "
+                        "attention")
+    p.add_argument("--attn-block", type=int, default=None,
+                   help="block size for --attn blockwise|flash (default 128)")
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="grouped-query attention for lm_* models: number "
+                        "of KV heads (must divide the model's num_heads; "
+                        "shrinks the KV cache by num_heads/kv_heads)")
     p.add_argument("--sp-strategy", default="ring",
                    choices=["ring", "ulysses"],
                    help="context-parallel attention for --spmd sp: 'ring' "
@@ -222,6 +235,35 @@ def main(argv=None) -> int:
         sp_kwargs = {"attn_fn": make_attn(
             sp_mesh, batch_axis="data", causal=True)}
 
+    # Attention-core selection for the LM family (one flag, shared
+    # wiring with benchmarks/lm_bench.py via ops.attention_core)
+    attn_kwargs = {}
+    if args.attn_block is not None and args.attn == "dense":
+        raise SystemExit("--attn-block only applies with --attn "
+                         "blockwise|flash")
+    if args.attn_block is not None and args.attn_block <= 0:
+        raise SystemExit(f"--attn-block must be > 0, got {args.attn_block}")
+    if args.attn != "dense":
+        from fluxdistributed_tpu.ops import attention_core
+
+        if not is_lm:
+            raise SystemExit("--attn only applies to lm_* models")
+        if args.spmd == "sp":
+            raise SystemExit("--attn conflicts with --spmd sp: sequence "
+                             "parallelism picks its own attention core "
+                             "(use --sp-strategy)")
+        attn_kwargs = {"attn_fn": attention_core(
+            args.attn, args.attn_block if args.attn_block else 128)}
+    if args.kv_heads is not None:
+        if not is_lm:
+            raise SystemExit("--kv-heads only applies to lm_* models")
+        nheads = model_fn(vocab=args.vocab).num_heads
+        if args.kv_heads <= 0 or nheads % args.kv_heads:
+            raise SystemExit(
+                f"--kv-heads {args.kv_heads} must be > 0 and divide the "
+                f"model's num_heads ({nheads} for {args.model})")
+        attn_kwargs["num_kv_heads"] = args.kv_heads
+
     # MoE expert parallelism: the model's moe_fn closes over the mesh,
     # so the expert mesh is built BEFORE the model for this mode
     ep_mesh = None
@@ -251,7 +293,8 @@ def main(argv=None) -> int:
         # metrics; cycles must be explicit (the text stream is unbounded).
         # Pipeline modes build their own per-microbatch loss — passing a
         # loss_fn there is an error by design (trainer raises).
-        model = model_fn(vocab=args.vocab, **moe_kwargs, **sp_kwargs)
+        model = model_fn(vocab=args.vocab, **moe_kwargs, **sp_kwargs,
+                         **attn_kwargs)
         if args.spmd in ("pp", "pp_1f1b"):
             lm_extra = {"topk": ()}
         else:

@@ -94,6 +94,7 @@ class CausalSelfAttention(nn.Module):
     attn_fn: Optional[AttnFn] = None
     use_rope: bool = True
     decode: bool = False
+    num_kv_heads: Optional[int] = None  # GQA: None/num_heads → MHA
 
     @nn.compact
     def __call__(self, x):
@@ -110,19 +111,40 @@ class CausalSelfAttention(nn.Module):
         b, t, d = x.shape
         assert d % self.num_heads == 0, "embed dim must divide num_heads"
         head_dim = d // self.num_heads
-        qkv = nn.DenseGeneral(
-            (3, self.num_heads, head_dim), axis=-1, dtype=self.dtype, name="qkv"
-        )(x)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        hkv = self.num_kv_heads or self.num_heads
+        if self.num_heads % hkv:
+            raise ValueError(
+                f"num_heads ({self.num_heads}) must be a multiple of "
+                f"num_kv_heads ({hkv})")
+        if hkv != self.num_heads:
+            # Grouped-query attention: separate projections so K/V carry
+            # only hkv heads — the KV cache (and decode HBM traffic)
+            # shrinks by num_heads/hkv, and the attention cores consume
+            # the grouped layout directly (the Pallas kernel natively,
+            # the XLA cores by a fused broadcast).
+            q = nn.DenseGeneral(
+                (self.num_heads, head_dim), axis=-1, dtype=self.dtype,
+                name="q",
+            )(x)
+            kv = nn.DenseGeneral(
+                (2, hkv, head_dim), axis=-1, dtype=self.dtype, name="kv"
+            )(x)
+            k, v = kv[:, :, 0], kv[:, :, 1]
+        else:
+            qkv = nn.DenseGeneral(
+                (3, self.num_heads, head_dim), axis=-1, dtype=self.dtype,
+                name="qkv",
+            )(x)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
         if self.decode:
             is_init = not self.has_variable("cache", "cached_k")
             # at init, t is the FULL target length -> static cache shape
             cached_k = self.variable(
-                "cache", "cached_k", jnp.zeros, (b, t, self.num_heads, head_dim), k.dtype
+                "cache", "cached_k", jnp.zeros, (b, t, hkv, head_dim), k.dtype
             )
             cached_v = self.variable(
-                "cache", "cached_v", jnp.zeros, (b, t, self.num_heads, head_dim), v.dtype
+                "cache", "cached_v", jnp.zeros, (b, t, hkv, head_dim), v.dtype
             )
             cache_index = self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
@@ -176,6 +198,7 @@ class DecoderBlock(nn.Module):
     attn_fn: Optional[AttnFn] = None
     use_rope: bool = True
     decode: bool = False
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -185,6 +208,7 @@ class DecoderBlock(nn.Module):
         y = CausalSelfAttention(
             self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn,
             use_rope=self.use_rope, decode=self.decode,
+            num_kv_heads=self.num_kv_heads,
         )(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -219,6 +243,7 @@ class MoEDecoderBlock(nn.Module):
     attn_fn: Optional[AttnFn] = None
     use_rope: bool = True
     decode: bool = False
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -226,6 +251,7 @@ class MoEDecoderBlock(nn.Module):
         y = CausalSelfAttention(
             self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn,
             use_rope=self.use_rope, decode=self.decode,
+            num_kv_heads=self.num_kv_heads,
         )(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -274,6 +300,7 @@ class TransformerLM(nn.Module):
     use_rope: bool = True
     tie_embeddings: bool = True
     decode: bool = False
+    num_kv_heads: Optional[int] = None  # GQA: grouped KV heads
     # rematerialize each block in the backward pass: activations for only
     # ~one block live at a time, trading ~1 extra forward of FLOPs for
     # O(depth)x less activation memory -> longer sequences / bigger
@@ -329,13 +356,15 @@ class TransformerLM(nn.Module):
                     self.num_heads, self.mlp_dim, self.num_experts,
                     self.moe_fn, dtype=self.dtype, dropout=self.dropout,
                     attn_fn=self.attn_fn, use_rope=self.use_rope,
-                    decode=self.decode, name=f"block{i}",
+                    decode=self.decode, num_kv_heads=self.num_kv_heads,
+                    name=f"block{i}",
                 )(x, train)
             else:
                 x = block_cls(
                     self.num_heads, self.mlp_dim, dtype=self.dtype,
                     dropout=self.dropout, attn_fn=self.attn_fn,
-                    use_rope=self.use_rope, decode=self.decode, name=f"block{i}",
+                    use_rope=self.use_rope, decode=self.decode,
+                    num_kv_heads=self.num_kv_heads, name=f"block{i}",
                 )(x, train)
         x = nn.LayerNorm(dtype=self.dtype, name="final_ln")(x)
         if self.tie_embeddings:
@@ -535,6 +564,7 @@ def _pp_validate_and_stage(model: "TransformerLM", mesh, pipe_axis: str, what: s
     blk = DecoderBlock(
         model.num_heads, model.mlp_dim, dtype=model.dtype,
         dropout=0.0, use_rope=model.use_rope, attn_fn=model.attn_fn,
+        num_kv_heads=model.num_kv_heads,
     )
 
     def base_fn(p, x):

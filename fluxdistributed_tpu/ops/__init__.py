@@ -1,6 +1,6 @@
 from .losses import logitcrossentropy, crossentropy, mse
 from .metrics import topkaccuracy, onehot, showpreds
-from .attention import dot_product_attention, blockwise_attention
+from .attention import attention_core, blockwise_attention, dot_product_attention
 
 __all__ = [
     "logitcrossentropy",
@@ -11,4 +11,5 @@ __all__ = [
     "showpreds",
     "dot_product_attention",
     "blockwise_attention",
+    "attention_core",
 ]

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 __all__ = [
     "dot_product_attention",
     "blockwise_attention",
+    "attention_core",
     "AttnCarry",
     "attn_block_update",
     "attn_finalize",
@@ -34,8 +35,49 @@ __all__ = [
 NEG_INF = -1e30
 
 
+def attention_core(kind: str, block: int = 128):
+    """Resolve an ``--attn``-style core name to a causal ``attn_fn``.
+
+    The single source of the dense/blockwise/flash wiring shared by
+    ``bin/driver.py`` and ``benchmarks/lm_bench.py`` (one flag, one
+    meaning).  ``"dense"`` → None (the model's built-in core).
+    """
+    from functools import partial
+
+    if kind == "dense":
+        return None
+    if block <= 0:
+        raise ValueError(f"attention block size must be > 0, got {block}")
+    if kind == "blockwise":
+        return partial(blockwise_attention, block_size=block, causal=True)
+    if kind == "flash":
+        from .pallas_attention import flash_attention
+
+        return partial(
+            flash_attention, causal=True, block_q=block, block_k=block)
+    raise ValueError(f"unknown attention core {kind!r}")
+
+
 def _scale(q):
     return q / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32)).astype(q.dtype)
+
+
+def _expand_kv(q, k, v):
+    """Broadcast grouped KV heads up to the query head count (GQA).
+
+    The XLA cores take the simple route — materialize the repeat and let
+    the compiler fuse it; the Pallas kernel instead maps query-head
+    programs onto shared KV blocks so grouped KV is never repeated in
+    HBM (ops/pallas_attention.py).
+    """
+    h, hkv = q.shape[2], k.shape[2]
+    if h == hkv:
+        return k, v
+    if h % hkv:
+        raise ValueError(
+            f"num query heads ({h}) must be a multiple of num KV heads ({hkv})")
+    rep = h // hkv
+    return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
 
 
 def dot_product_attention(
@@ -55,7 +97,10 @@ def dot_product_attention(
     Rows with NO attendable position (all-False mask row, or causal rows
     before the first key when Tq > Tk) return exactly 0 — the same
     convention as every other attention implementation in this package.
+    Grouped-query KV ([B, Tk, Hkv, D] with Hkv dividing H) is accepted
+    and broadcast to the query head count.
     """
+    k, v = _expand_kv(q, k, v)
     q = _scale(q)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     tq, tk = s.shape[-2], s.shape[-1]
@@ -176,7 +221,9 @@ def blockwise_attention(
     full sequence) with identical numerics to ``dot_product_attention``.
     This is the XLA fallback for the Pallas kernel and the single-device
     analog of ring attention (one ring hop == one scan iteration).
+    Grouped-query KV is accepted (broadcast to the query head count).
     """
+    k, v = _expand_kv(q, k, v)
     b, tq, h, d = q.shape
     tk = k.shape[1]
     block_size = min(block_size, tk)

@@ -79,12 +79,16 @@ def _flash_kernel(
     k_start = kj * block_k
 
     def _body():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
+        # Operands stay in their stored dtype: bf16 inputs ride the
+        # MXU's native bf16×bf16→f32-accumulate path (casting to f32
+        # first would halve MXU throughput).  The scale multiplies the
+        # f32 scores, not the inputs, so no precision is lost to it.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [block_q, block_k]
+        )  # [block_q, block_k] f32
 
         if causal or padded:
             k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -99,7 +103,8 @@ def _flash_kernel(
             s, m_ref[:, 0], l_ref[:, 0], mask=mask
         )
         acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
@@ -132,15 +137,16 @@ def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     contributes nothing to dQ, but their score is 0 and exp(0 - LSE)
     can overflow to inf when a row's LSE < ~-88, and inf · 0 = NaN.
     """
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    # native-dtype operands → bf16 MXU path, f32 accumulation (see fwd)
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0]
     delta = delta_ref[0]
     s = scale * jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # [block_q, block_k]
+    )  # [block_q, block_k] f32
     p = jnp.exp(s - lse[:, None])
     if causal or padded:
         k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -180,8 +186,9 @@ def _flash_dq_kernel(
             causal_offset=causal_offset, padded=padded,
             q_start=q_start, k_start=k_start,
         )
+        k = k_ref[0]
         dq_acc_ref[:] += scale * jax.lax.dot_general(
-            ds, k_ref[0].astype(jnp.float32),
+            ds.astype(k.dtype), k,
             (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
         )
 
@@ -198,15 +205,19 @@ def _flash_dq_kernel(
 def _flash_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc_ref, dv_acc_ref,
-    *, scale, causal, tk_valid, causal_offset, padded,
+    *, scale, causal, tk_valid, causal_offset, padded, nq,
 ):
+    """Inner grid axis t = member * nq + qi: with GQA, each KV head's
+    accumulator folds the q-blocks of all `group` query heads sharing
+    it (group == 1 degenerates to t == qi)."""
     _, block_q, _ = q_ref.shape
     _, block_k, _ = k_ref.shape
     kj = pl.program_id(1)
-    qi = pl.program_id(2)
-    nq = pl.num_programs(2)
+    t = pl.program_id(2)
+    ntot = pl.num_programs(2)
+    qi = t % nq
 
-    @pl.when(qi == 0)
+    @pl.when(t == 0)
     def _init():
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
@@ -221,12 +232,14 @@ def _flash_dkv_kernel(
             causal_offset=causal_offset, padded=padded,
             q_start=q_start, k_start=k_start,
         )
+        do = do_ref[0]
+        q = q_ref[0]
         dv_acc_ref[:] += jax.lax.dot_general(
-            p, do_ref[0].astype(jnp.float32),
+            p.astype(do.dtype), do,
             (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
         )  # pᵀ·dO: contract over the q dimension → [block_k, d]
         dk_acc_ref[:] += scale * jax.lax.dot_general(
-            ds, q_ref[0].astype(jnp.float32),
+            ds.astype(q.dtype), q,
             (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
         )  # dSᵀ·Q → [block_k, d]
 
@@ -235,7 +248,7 @@ def _flash_dkv_kernel(
     else:
         _body()
 
-    @pl.when(qi == nq - 1)
+    @pl.when(t == ntot - 1)
     def _finalize():
         dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
@@ -258,12 +271,26 @@ def _unfold(x, b, h, t):
     return x[:, :t].reshape(b, h, t, x.shape[-1]).transpose(0, 2, 1, 3)
 
 
+def _gqa_dims(q, k):
+    """(h, hkv, group) with the divisibility check — GQA folds q heads
+    into batch as usual while the BlockSpec index maps point each group
+    of query heads at its SHARED KV head, so grouped KV is never
+    repeated in HBM (the whole point of GQA's memory saving)."""
+    h, hkv = q.shape[2], k.shape[2]
+    if h % hkv:
+        raise ValueError(
+            f"num query heads ({h}) must be a multiple of num KV heads "
+            f"({hkv}) for grouped-query attention")
+    return h, hkv, h // hkv
+
+
 @functools.partial(
     jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
 )
 def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     b, tq, h, d = q.shape
     tk = k.shape[1]
+    h, hkv, group = _gqa_dims(q, k)
     scale = 1.0 / (d**0.5)
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
@@ -273,6 +300,9 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     kf = _pad_seq(_fold(k), block_k)
     vf = _pad_seq(_fold(v), block_k)
     tq_p, tk_p = qf.shape[1], kf.shape[1]
+
+    def kv_bh(bh):  # query-head program → its KV head's fold index
+        return (bh // h) * hkv + (bh % h) // group
 
     grid = (b * h, tq_p // block_q, tk_p // block_k)
     kernel = functools.partial(
@@ -284,8 +314,8 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (kv_bh(bh), j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (kv_bh(bh), j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
@@ -312,6 +342,7 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
                     g_lse=None):
     b, tq, h, d = q.shape
     tk = k.shape[1]
+    h, hkv, group = _gqa_dims(q, k)
     scale = 1.0 / (d**0.5)
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
@@ -338,13 +369,26 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
 
     nq, nk = tq_p // block_q, tk_p // block_k
     bh = b * h
+
+    def kv_bh(bh_):  # query-head program → its KV head's fold index
+        return (bh_ // h) * hkv + (bh_ % h) // group
+
+    def q_bh(bh_, t):  # (KV-head program, inner step) → q-head fold index
+        return (bh_ // hkv) * h + (bh_ % hkv) * group + t // nq
+
     q_spec_i = pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0))
-    kv_spec_j = pl.BlockSpec((1, block_k, d), lambda bh_, i, j: (bh_, j, 0))
+    kv_spec_j = pl.BlockSpec(
+        (1, block_k, d), lambda bh_, i, j: (kv_bh(bh_), j, 0))
     row_spec_i = pl.BlockSpec((1, block_q), lambda bh_, i, j: (bh_, i))
-    # dKV grid is (bh, j, i): q-indexed operands follow the INNER axis.
-    q_spec_inner = pl.BlockSpec((1, block_q, d), lambda bh_, j, i: (bh_, i, 0))
-    kv_spec_outer = pl.BlockSpec((1, block_k, d), lambda bh_, j, i: (bh_, j, 0))
-    row_spec_inner = pl.BlockSpec((1, block_q), lambda bh_, j, i: (bh_, i))
+    # dKV grid is (b*hkv, j, t) where the inner axis t enumerates the
+    # nq q-blocks of each of the `group` query heads sharing this KV
+    # head: t = member * nq + qi.
+    q_spec_inner = pl.BlockSpec(
+        (1, block_q, d), lambda bh_, j, t: (q_bh(bh_, t), t % nq, 0))
+    kv_spec_outer = pl.BlockSpec(
+        (1, block_k, d), lambda bh_, j, t: (bh_, j, 0))
+    row_spec_inner = pl.BlockSpec(
+        (1, block_q), lambda bh_, j, t: (q_bh(bh_, t), t % nq))
 
     common = dict(
         scale=scale, causal=causal, tk_valid=tk, causal_offset=tk - tq,
@@ -362,17 +406,17 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
     )(qf, kf, vf, dof, lse_p, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_dkv_kernel, **common),
-        grid=(bh, nk, nq),
+        functools.partial(_flash_dkv_kernel, **common, nq=nq),
+        grid=(b * hkv, nk, nq * group),
         in_specs=[q_spec_inner, kv_spec_outer, kv_spec_outer, q_spec_inner,
                   row_spec_inner, row_spec_inner],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bh_, j, i: (bh_, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, j, i: (bh_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, j, t: (bh_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, j, t: (bh_, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tk_p, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, tk_p, d), v.dtype),
+            jax.ShapeDtypeStruct((b * hkv, tk_p, d), k.dtype),
+            jax.ShapeDtypeStruct((b * hkv, tk_p, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -383,8 +427,8 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
 
     return (
         _unfold(dq, b, h, tq),
-        _unfold(dk, b, h, tk),
-        _unfold(dv, b, h, tk),
+        _unfold(dk, b, hkv, tk),
+        _unfold(dv, b, hkv, tk),
     )
 
 

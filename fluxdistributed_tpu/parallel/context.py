@@ -35,6 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import (
     NEG_INF,
+    _expand_kv,
     _scale,
     attn_block_update,
     attn_finalize,
@@ -82,7 +83,11 @@ def ring_attention(
         if causal:
             k_pos = blk * t_local + jnp.arange(t_local)
             mask = k_pos[None, :] <= q_pos[:, None]
-        carry = attn_block_update(carry, q_scaled, k_cur, v_cur, mask=mask)
+        # grouped KV rides the ring at hkv heads (the GQA bandwidth win
+        # applies to ppermute traffic too); expand only for the local
+        # block update
+        k_blk, v_blk = _expand_kv(q_scaled, k_cur, v_cur)
+        carry = attn_block_update(carry, q_scaled, k_blk, v_blk, mask=mask)
         # One more rotation than strictly needed on the last hop would
         # waste a transfer; guard via cond-free arithmetic is not worth
         # it — XLA overlaps the permute with the block compute.
@@ -215,6 +220,7 @@ def ulysses_attention(
     """
     from ..ops.attention import dot_product_attention
 
+    k, v = _expand_kv(q, k, v)  # grouped KV → query head count
     axis_size = jax.lax.psum(1, axis_name)
     assert q.shape[2] % axis_size == 0, (
         f"'{axis_name}' axis size {axis_size} must divide num_heads {q.shape[2]}"

@@ -148,6 +148,18 @@ def lm_tp_rules(
             return P(None, None, model_axis, None)
         if path.endswith("qkv/bias"):
             return P(None, model_axis, None)
+        # GQA layout (num_kv_heads set): separate q [d, Hq, hd] and
+        # kv [d, 2, Hkv, hd] projections, both column-sharded over heads
+        # (needs Hkv % model_axis == 0; the ordering matters — "qkv/"
+        # already returned above, so "kv/" cannot swallow it)
+        if path.endswith("kv/kernel"):
+            return P(None, None, model_axis, None)
+        if path.endswith("kv/bias"):
+            return P(None, model_axis, None)
+        if path.endswith("q/kernel"):
+            return P(None, model_axis, None)
+        if path.endswith("q/bias"):
+            return P(model_axis, None)
         if path.endswith("out/kernel"):
             return P(model_axis, None, None)
         if path.endswith("head/kernel"):  # untied output head
