@@ -41,6 +41,9 @@ def main():
     ap.add_argument("--dim", type=int, default=64)
     ap.add_argument("--block", type=int, default=128)
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--window", type=int, default=None,
+                    help="add a windowed pallas-flash row (block-skip "
+                         "FLOPs saving at long T)")
     args = ap.parse_args()
 
     import jax
@@ -63,6 +66,10 @@ def main():
         ("pallas-flash", jax.jit(
             lambda q, k, v: flash_attention(q, k, v, True, blk, blk))),
     ]
+    if args.window:
+        w = args.window
+        cores.append((f"pallas-flash-w{w}", jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, True, blk, blk, w))))
     grads = {
         name: jax.jit(jax.grad(lambda q, k, v, f=fn: jnp.sum(f(q, k, v).astype(jnp.float32)),
                                argnums=(0, 1, 2)))

@@ -38,6 +38,8 @@ def main():
     ap.add_argument("--attn-block", type=int, default=128)
     ap.add_argument("--kv-heads", type=int, default=None,
                     help="grouped-query attention: number of KV heads")
+    ap.add_argument("--window", type=int, default=None,
+                    help="sliding-window attention (newest WINDOW keys)")
     ap.add_argument("--seconds", type=float, default=2.0)
     ap.add_argument("--platform", default=None, help="force platform (e.g. cpu)")
     args = ap.parse_args()
@@ -60,8 +62,8 @@ def main():
 
     model = getattr(models, args.model)(
         vocab=args.vocab, remat=args.remat,
-        attn_fn=attention_core(args.attn, args.attn_block),
-        num_kv_heads=args.kv_heads)
+        attn_fn=attention_core(args.attn, args.attn_block, window=args.window),
+        num_kv_heads=args.kv_heads, window=args.window)
     rng = np.random.default_rng(0)
     toks = rng.integers(0, args.vocab, (batch, args.seqlen)).astype(np.int32)
     params = model.init(jax.random.PRNGKey(0), toks[:1], train=False)["params"]

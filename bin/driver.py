@@ -113,6 +113,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="grouped-query attention for lm_* models: number "
                         "of KV heads (must divide the model's num_heads; "
                         "shrinks the KV cache by num_heads/kv_heads)")
+    p.add_argument("--window", type=int, default=None,
+                   help="sliding-window attention for lm_* models: each "
+                        "query attends its WINDOW newest keys (O(T*W) "
+                        "attention; with --attn flash, out-of-band KV "
+                        "blocks are skipped entirely)")
     p.add_argument("--sp-strategy", default="ring",
                    choices=["ring", "ulysses"],
                    help="context-parallel attention for --spmd sp: 'ring' "
@@ -243,6 +248,17 @@ def main(argv=None) -> int:
                          "blockwise|flash")
     if args.attn_block is not None and args.attn_block <= 0:
         raise SystemExit(f"--attn-block must be > 0, got {args.attn_block}")
+    if args.window is not None:
+        if not is_lm:
+            raise SystemExit("--window only applies to lm_* models")
+        if args.window < 1:
+            raise SystemExit(f"--window must be >= 1, got {args.window}")
+        if args.spmd == "sp":
+            raise SystemExit("--window is not supported with --spmd sp "
+                             "(context-parallel attention is unwindowed)")
+        # the model field windows the default dense core AND the decode
+        # path; a non-dense attn_fn gets its own window below
+        attn_kwargs["window"] = args.window
     if args.attn != "dense":
         from fluxdistributed_tpu.ops import attention_core
 
@@ -252,8 +268,9 @@ def main(argv=None) -> int:
             raise SystemExit("--attn conflicts with --spmd sp: sequence "
                              "parallelism picks its own attention core "
                              "(use --sp-strategy)")
-        attn_kwargs = {"attn_fn": attention_core(
-            args.attn, args.attn_block if args.attn_block else 128)}
+        attn_kwargs["attn_fn"] = attention_core(
+            args.attn, args.attn_block if args.attn_block else 128,
+            window=args.window)
     if args.kv_heads is not None:
         if not is_lm:
             raise SystemExit("--kv-heads only applies to lm_* models")
@@ -262,6 +279,15 @@ def main(argv=None) -> int:
             raise SystemExit(
                 f"--kv-heads {args.kv_heads} must be > 0 and divide the "
                 f"model's num_heads ({nheads} for {args.model})")
+        if args.spmd in ("tp", "fsdp_tp"):
+            # lm_tp_rules head-shards the kv projection: the model axis
+            # must divide the KV head count or sharding fails cryptically
+            model_k = args.tp if args.tp is not None else jax.device_count()
+            if args.kv_heads % model_k:
+                raise SystemExit(
+                    f"--kv-heads {args.kv_heads} must be a multiple of the "
+                    f"TP model-axis size ({model_k}) so the grouped kv "
+                    f"projection can be head-sharded")
         attn_kwargs["num_kv_heads"] = args.kv_heads
 
     # MoE expert parallelism: the model's moe_fn closes over the mesh,

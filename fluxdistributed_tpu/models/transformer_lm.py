@@ -95,6 +95,7 @@ class CausalSelfAttention(nn.Module):
     use_rope: bool = True
     decode: bool = False
     num_kv_heads: Optional[int] = None  # GQA: None/num_heads → MHA
+    window: Optional[int] = None  # sliding-window attention (causal)
 
     @nn.compact
     def __call__(self, x):
@@ -166,9 +167,15 @@ class CausalSelfAttention(nn.Module):
                 )
                 cache_index.value = idx + t
                 # query i (global position idx+i) attends keys [0, idx+i]
-                allow = (
-                    jnp.arange(total)[None, :] <= (idx + jnp.arange(t))[:, None]
-                )[None, None]  # [1, 1, t, total]
+                q_glob = (idx + jnp.arange(t))[:, None]
+                allow = jnp.arange(total)[None, :] <= q_glob
+                if self.window is not None:
+                    # the grouped cache still holds every position, but
+                    # attention reads only the window's newest keys
+                    allow &= (
+                        jnp.arange(total)[None, :] >= q_glob - (self.window - 1)
+                    )
+                allow = allow[None, None]  # [1, 1, t, total]
                 out = dot_product_attention(
                     q, cached_k.value, cached_v.value, mask=allow
                 )
@@ -184,8 +191,11 @@ class CausalSelfAttention(nn.Module):
         attn = (
             self.attn_fn
             if self.attn_fn is not None
-            else partial(dot_product_attention, causal=True)
+            else partial(dot_product_attention, causal=True,
+                         window=self.window)
         )
+        # a custom attn_fn owns its own windowing (attention_core(...,
+        # window=...) builds one); the model only windows the defaults
         out = attn(q, k, v)  # [B, T, H, Dh]
         return nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype, name="out")(out)
 
@@ -199,6 +209,7 @@ class DecoderBlock(nn.Module):
     use_rope: bool = True
     decode: bool = False
     num_kv_heads: Optional[int] = None
+    window: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -208,7 +219,7 @@ class DecoderBlock(nn.Module):
         y = CausalSelfAttention(
             self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn,
             use_rope=self.use_rope, decode=self.decode,
-            num_kv_heads=self.num_kv_heads,
+            num_kv_heads=self.num_kv_heads, window=self.window,
         )(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -244,6 +255,7 @@ class MoEDecoderBlock(nn.Module):
     use_rope: bool = True
     decode: bool = False
     num_kv_heads: Optional[int] = None
+    window: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -251,7 +263,7 @@ class MoEDecoderBlock(nn.Module):
         y = CausalSelfAttention(
             self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn,
             use_rope=self.use_rope, decode=self.decode,
-            num_kv_heads=self.num_kv_heads,
+            num_kv_heads=self.num_kv_heads, window=self.window,
         )(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -301,6 +313,7 @@ class TransformerLM(nn.Module):
     tie_embeddings: bool = True
     decode: bool = False
     num_kv_heads: Optional[int] = None  # GQA: grouped KV heads
+    window: Optional[int] = None  # sliding-window attention
     # rematerialize each block in the backward pass: activations for only
     # ~one block live at a time, trading ~1 extra forward of FLOPs for
     # O(depth)x less activation memory -> longer sequences / bigger
@@ -357,14 +370,15 @@ class TransformerLM(nn.Module):
                     self.moe_fn, dtype=self.dtype, dropout=self.dropout,
                     attn_fn=self.attn_fn, use_rope=self.use_rope,
                     decode=self.decode, num_kv_heads=self.num_kv_heads,
-                    name=f"block{i}",
+                    window=self.window, name=f"block{i}",
                 )(x, train)
             else:
                 x = block_cls(
                     self.num_heads, self.mlp_dim, dtype=self.dtype,
                     dropout=self.dropout, attn_fn=self.attn_fn,
                     use_rope=self.use_rope, decode=self.decode,
-                    num_kv_heads=self.num_kv_heads, name=f"block{i}",
+                    num_kv_heads=self.num_kv_heads, window=self.window,
+                    name=f"block{i}",
                 )(x, train)
         x = nn.LayerNorm(dtype=self.dtype, name="final_ln")(x)
         if self.tie_embeddings:
@@ -564,7 +578,7 @@ def _pp_validate_and_stage(model: "TransformerLM", mesh, pipe_axis: str, what: s
     blk = DecoderBlock(
         model.num_heads, model.mlp_dim, dtype=model.dtype,
         dropout=0.0, use_rope=model.use_rope, attn_fn=model.attn_fn,
-        num_kv_heads=model.num_kv_heads,
+        num_kv_heads=model.num_kv_heads, window=model.window,
     )
 
     def base_fn(p, x):

@@ -35,26 +35,34 @@ __all__ = [
 NEG_INF = -1e30
 
 
-def attention_core(kind: str, block: int = 128):
+def attention_core(kind: str, block: int = 128, window: Optional[int] = None):
     """Resolve an ``--attn``-style core name to a causal ``attn_fn``.
 
     The single source of the dense/blockwise/flash wiring shared by
     ``bin/driver.py`` and ``benchmarks/lm_bench.py`` (one flag, one
-    meaning).  ``"dense"`` → None (the model's built-in core).
+    meaning).  ``"dense"`` → None when no window is set (the model's
+    built-in core), else a windowed dense core.  ``window`` restricts
+    each query to its ``window`` newest keys (sliding-window attention;
+    only the flash core skips out-of-band blocks' FLOPs).
     """
     from functools import partial
 
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     if kind == "dense":
-        return None
+        if window is None:
+            return None
+        return partial(dot_product_attention, causal=True, window=window)
     if block <= 0:
         raise ValueError(f"attention block size must be > 0, got {block}")
     if kind == "blockwise":
-        return partial(blockwise_attention, block_size=block, causal=True)
+        return partial(blockwise_attention, block_size=block, causal=True,
+                       window=window)
     if kind == "flash":
         from .pallas_attention import flash_attention
 
-        return partial(
-            flash_attention, causal=True, block_q=block, block_k=block)
+        return partial(flash_attention, causal=True, block_q=block,
+                       block_k=block, window=window)
     raise ValueError(f"unknown attention core {kind!r}")
 
 
@@ -87,6 +95,7 @@ def dot_product_attention(
     *,
     causal: bool = False,
     mask: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Reference softmax attention, one XLA fusion.
 
@@ -100,6 +109,8 @@ def dot_product_attention(
     Grouped-query KV ([B, Tk, Hkv, D] with Hkv dividing H) is accepted
     and broadcast to the query head count.
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     k, v = _expand_kv(q, k, v)
     q = _scale(q)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
@@ -108,7 +119,11 @@ def dot_product_attention(
     if causal:
         # Align ends: allows Tq != Tk (e.g. decoding with a KV cache).
         idx_q = jnp.arange(tq)[:, None] + (tk - tq)
-        allow = (jnp.arange(tk)[None, :] <= idx_q)[None, None]
+        allow = jnp.arange(tk)[None, :] <= idx_q
+        if window is not None:
+            # sliding window: each query sees its `window` newest keys
+            allow &= jnp.arange(tk)[None, :] >= idx_q - (window - 1)
+        allow = allow[None, None]
     if mask is not None:
         allow = mask if allow is None else allow & mask
     if allow is not None:
@@ -214,6 +229,7 @@ def blockwise_attention(
     *,
     block_size: int = 512,
     causal: bool = False,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Flash-style attention via ``lax.scan`` over KV blocks.
 
@@ -222,7 +238,12 @@ def blockwise_attention(
     This is the XLA fallback for the Pallas kernel and the single-device
     analog of ring attention (one ring hop == one scan iteration).
     Grouped-query KV is accepted (broadcast to the query head count).
+    ``window`` (causal only) masks keys older than the query's
+    ``window`` newest — the scan still visits every block (use the
+    Pallas kernel for the FLOPs saving).
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     k, v = _expand_kv(q, k, v)
     b, tq, h, d = q.shape
     tk = k.shape[1]
@@ -244,6 +265,8 @@ def blockwise_attention(
         mask = k_pos[None, :] < tk
         if causal:
             mask &= k_pos[None, :] <= q_pos[:, None]
+            if window is not None:
+                mask &= k_pos[None, :] >= q_pos[:, None] - (window - 1)
         elif not pad:
             mask = None
         return attn_block_update(carry, q_scaled, k_blk, v_blk, mask=mask), None

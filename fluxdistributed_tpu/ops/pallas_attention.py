@@ -56,13 +56,48 @@ _LANES = 128
 _LSE_PAD = 1e30
 
 
+def _block_relevant(q_start, k_start, block_q, block_k,
+                    causal, causal_offset, window):
+    """Static-shape predicate: does KV block ``kj`` intersect the causal
+    (and sliding-window) band of Q block ``qi`` at all?  False blocks are
+    skipped with ``pl.when`` — with a window this is where the FLOPs
+    saving comes from: far-past KV blocks never touch the MXU."""
+    cond = True
+    if causal:
+        # any (q, k) with k <= q + offset?
+        cond = k_start <= q_start + block_q - 1 + causal_offset
+        if window is not None:
+            # any (q, k) with k >= q + offset - (window-1)?
+            cond &= (k_start + block_k - 1
+                     >= q_start + causal_offset - (window - 1))
+    return cond
+
+
+def _band_mask(s_shape, q_start, k_start, *,
+               causal, tk_valid, causal_offset, window, padded):
+    """The shared fwd/bwd attend-mask for one [block_q, block_k] tile
+    (None when every position is attendable)."""
+    if not (causal or padded):
+        return None
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s_shape, 1)
+    mask = k_pos < tk_valid
+    if causal:
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s_shape, 0)
+        mask &= k_pos <= q_pos + causal_offset
+        if window is not None:
+            mask &= k_pos >= q_pos + causal_offset - (window - 1)
+    return mask
+
+
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, scale, causal, tk_valid, causal_offset, padded,
+    *, scale, causal, tk_valid, causal_offset, padded, window,
 ):
     """``causal_offset = Tk_valid - Tq_valid`` end-aligns the causal mask
     (query i attends keys <= i + offset), matching
-    ``dot_product_attention``'s KV-cache-decode convention."""
+    ``dot_product_attention``'s KV-cache-decode convention.  ``window``
+    (sliding-window attention, causal only) restricts each query to its
+    ``window`` most recent keys."""
     _, block_q, _ = q_ref.shape
     _, block_k, _ = k_ref.shape
     qi = pl.program_id(1)
@@ -90,15 +125,10 @@ def _flash_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k] f32
 
-        if causal or padded:
-            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = k_pos < tk_valid
-            if causal:
-                q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-                mask &= k_pos <= q_pos + causal_offset
-        else:
-            mask = None  # aligned non-causal: skip mask VPU work entirely
-
+        mask = _band_mask(
+            s.shape, q_start, k_start, causal=causal, tk_valid=tk_valid,
+            causal_offset=causal_offset, window=window, padded=padded,
+        )
         p, corr, m_new, l_new = online_softmax_update(
             s, m_ref[:, 0], l_ref[:, 0], mask=mask
         )
@@ -110,8 +140,10 @@ def _flash_kernel(
         l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
     if causal:
-        # Skip KV blocks entirely above the causal diagonal (no MXU work).
-        pl.when(k_start <= q_start + block_q - 1 + causal_offset)(_body)
+        # Skip KV blocks entirely outside the causal/window band.
+        pl.when(_block_relevant(
+            q_start, k_start, block_q, block_k, causal, causal_offset, window
+        ))(_body)
     else:
         _body()
 
@@ -125,7 +157,7 @@ def _flash_kernel(
 
 
 def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-              *, scale, causal, tk_valid, causal_offset, padded,
+              *, scale, causal, tk_valid, causal_offset, padded, window,
               q_start, k_start):
     """Shared dQ/dKV tile recompute: returns (p, ds), both [bq, bk] f32.
 
@@ -148,12 +180,11 @@ def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # [block_q, block_k] f32
     p = jnp.exp(s - lse[:, None])
-    if causal or padded:
-        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = k_pos < tk_valid
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            mask &= k_pos <= q_pos + causal_offset
+    mask = _band_mask(
+        s.shape, q_start, k_start, causal=causal, tk_valid=tk_valid,
+        causal_offset=causal_offset, window=window, padded=padded,
+    )
+    if mask is not None:
         p = jnp.where(mask, p, 0.0)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -164,7 +195,7 @@ def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc_ref,
-    *, scale, causal, tk_valid, causal_offset, padded,
+    *, scale, causal, tk_valid, causal_offset, padded, window,
 ):
     _, block_q, _ = q_ref.shape
     _, block_k, _ = k_ref.shape
@@ -183,7 +214,7 @@ def _flash_dq_kernel(
         _, ds = _bwd_tile(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             scale=scale, causal=causal, tk_valid=tk_valid,
-            causal_offset=causal_offset, padded=padded,
+            causal_offset=causal_offset, padded=padded, window=window,
             q_start=q_start, k_start=k_start,
         )
         k = k_ref[0]
@@ -193,7 +224,9 @@ def _flash_dq_kernel(
         )
 
     if causal:
-        pl.when(k_start <= q_start + block_q - 1 + causal_offset)(_body)
+        pl.when(_block_relevant(
+            q_start, k_start, block_q, block_k, causal, causal_offset, window
+        ))(_body)
     else:
         _body()
 
@@ -205,7 +238,7 @@ def _flash_dq_kernel(
 def _flash_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc_ref, dv_acc_ref,
-    *, scale, causal, tk_valid, causal_offset, padded, nq,
+    *, scale, causal, tk_valid, causal_offset, padded, nq, window,
 ):
     """Inner grid axis t = member * nq + qi: with GQA, each KV head's
     accumulator folds the q-blocks of all `group` query heads sharing
@@ -229,7 +262,7 @@ def _flash_dkv_kernel(
         p, ds = _bwd_tile(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             scale=scale, causal=causal, tk_valid=tk_valid,
-            causal_offset=causal_offset, padded=padded,
+            causal_offset=causal_offset, padded=padded, window=window,
             q_start=q_start, k_start=k_start,
         )
         do = do_ref[0]
@@ -244,7 +277,9 @@ def _flash_dkv_kernel(
         )  # dSᵀ·Q → [block_k, d]
 
     if causal:
-        pl.when(k_start <= q_start + block_q - 1 + causal_offset)(_body)
+        pl.when(_block_relevant(
+            q_start, k_start, block_q, block_k, causal, causal_offset, window
+        ))(_body)
     else:
         _body()
 
@@ -285,9 +320,11 @@ def _gqa_dims(q, k):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret", "window"),
 )
-def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+                    window=None):
     b, tq, h, d = q.shape
     tk = k.shape[1]
     h, hkv, group = _gqa_dims(q, k)
@@ -307,7 +344,7 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     grid = (b * h, tq_p // block_q, tk_p // block_k)
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, tk_valid=tk,
-        causal_offset=tk - tq, padded=tk_p != tk,
+        causal_offset=tk - tq, padded=tk_p != tk, window=window,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -336,10 +373,11 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret", "window"),
 )
 def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
-                    g_lse=None):
+                    g_lse=None, window=None):
     b, tq, h, d = q.shape
     tk = k.shape[1]
     h, hkv, group = _gqa_dims(q, k)
@@ -392,7 +430,7 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
 
     common = dict(
         scale=scale, causal=causal, tk_valid=tk, causal_offset=tk - tq,
-        padded=tk_p != tk,
+        padded=tk_p != tk, window=window,
     )
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, **common),
@@ -432,7 +470,7 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -440,37 +478,50 @@ def flash_attention(
     causal: bool = False,
     block_q: int = 128,
     block_k: int = 128,
+    window: int | None = None,
 ) -> jax.Array:
     """Fused flash attention, [B, T, H, D] → [B, T, H, D].
 
     Runs the Pallas TPU kernels on TPU and the same kernels under the
     Pallas interpreter elsewhere (so CPU tests cover the real kernels),
     forward and backward.  Numerics match ``dot_product_attention`` to
-    f32 accumulation.
+    f32 accumulation.  Grouped-query KV ([B, T, Hkv, D]) is consumed
+    natively (never repeated in HBM).  ``window`` (requires ``causal``)
+    restricts each query to its ``window`` most recent keys — KV blocks
+    outside the band are SKIPPED, so long-T cost is O(T·window), not
+    O(T²).
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (sliding-window "
+                         "attention is a causal-LM construct)")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     interpret = jax.default_backend() != "tpu"
-    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+                             window=window)
     return out
 
 
-def _fwd(q, k, v, causal, block_q, block_k):
+def _fwd(q, k, v, causal, block_q, block_k, window):
     interpret = jax.default_backend() != "tpu"
-    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+                               window=window)
     return out, (q, k, v, out, lse)
 
 
-def _bwd(causal, block_q, block_k, res, g):
+def _bwd(causal, block_q, block_k, window, res, g):
     q, k, v, o, lse = res
     interpret = jax.default_backend() != "tpu"
     return _flash_bwd_impl(
-        q, k, v, o, lse, g, causal, block_q, block_k, interpret
+        q, k, v, o, lse, g, causal, block_q, block_k, interpret,
+        window=window,
     )
 
 
 flash_attention.defvjp(_fwd, _bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention_lse(
     q: jax.Array,
     k: jax.Array,
@@ -478,6 +529,7 @@ def flash_attention_lse(
     causal: bool = False,
     block_q: int = 128,
     block_k: int = 128,
+    window: int | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Flash attention that ALSO returns the per-row logsumexp.
 
@@ -489,27 +541,34 @@ def flash_attention_lse(
     position have ``lse ≈ -1e30`` (their combine weight underflows to
     exactly 0).
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (sliding-window "
+                         "attention is a causal-LM construct)")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     interpret = jax.default_backend() != "tpu"
-    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+                               window=window)
     b, tq, h, _ = q.shape
     return out, lse.reshape(b, h, tq)
 
 
-def _fwd_lse(q, k, v, causal, block_q, block_k):
+def _fwd_lse(q, k, v, causal, block_q, block_k, window):
     interpret = jax.default_backend() != "tpu"
-    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+                               window=window)
     b, tq, h, _ = q.shape
     return (out, lse.reshape(b, h, tq)), (q, k, v, out, lse)
 
 
-def _bwd_lse(causal, block_q, block_k, res, g):
+def _bwd_lse(causal, block_q, block_k, window, res, g):
     q, k, v, o, lse = res
     g_out, g_lse = g
     b, tq, h, _ = q.shape
     interpret = jax.default_backend() != "tpu"
     return _flash_bwd_impl(
         q, k, v, o, lse, g_out, causal, block_q, block_k, interpret,
-        g_lse=g_lse.reshape(b * h, tq),
+        g_lse=g_lse.reshape(b * h, tq), window=window,
     )
 
 
