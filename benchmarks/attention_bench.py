@@ -66,8 +66,10 @@ def main():
         ("pallas-flash", jax.jit(
             lambda q, k, v: flash_attention(q, k, v, True, blk, blk))),
     ]
-    if args.window:
+    if args.window is not None:
         w = args.window
+        if w < 1:
+            raise SystemExit(f"--window must be >= 1, got {w}")
         cores.append((f"pallas-flash-w{w}", jax.jit(
             lambda q, k, v: flash_attention(q, k, v, True, blk, blk, w))))
     grads = {

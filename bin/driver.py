@@ -279,9 +279,12 @@ def main(argv=None) -> int:
             raise SystemExit(
                 f"--kv-heads {args.kv_heads} must be > 0 and divide the "
                 f"model's num_heads ({nheads} for {args.model})")
-        if args.spmd in ("tp", "fsdp_tp"):
+        if args.spmd in ("tp", "fsdp_tp") and not (
+                args.spmd == "fsdp_tp" and args.tp is None):
             # lm_tp_rules head-shards the kv projection: the model axis
-            # must divide the KV head count or sharding fails cryptically
+            # must divide the KV head count or sharding fails cryptically.
+            # (fsdp_tp without --tp is itself invalid — the dedicated
+            # check below reports THAT, not a misleading kv-heads error.)
             model_k = args.tp if args.tp is not None else jax.device_count()
             if args.kv_heads % model_k:
                 raise SystemExit(

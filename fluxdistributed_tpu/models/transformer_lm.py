@@ -140,16 +140,29 @@ class CausalSelfAttention(nn.Module):
 
         if self.decode:
             is_init = not self.has_variable("cache", "cached_k")
-            # at init, t is the FULL target length -> static cache shape
+            # at init, t is the FULL target length -> static cache shape.
+            # With a window the cache is a ROLLING ring of `window` slots
+            # (O(window) memory regardless of generation length); slot
+            # positions live in a side buffer so the mask can recover
+            # global causality after wraparound.
+            cache_len = t if self.window is None else min(self.window, t)
             cached_k = self.variable(
-                "cache", "cached_k", jnp.zeros, (b, t, hkv, head_dim), k.dtype
+                "cache", "cached_k", jnp.zeros,
+                (b, cache_len, hkv, head_dim), k.dtype,
             )
             cached_v = self.variable(
-                "cache", "cached_v", jnp.zeros, (b, t, hkv, head_dim), v.dtype
+                "cache", "cached_v", jnp.zeros,
+                (b, cache_len, hkv, head_dim), v.dtype,
             )
             cache_index = self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
             )
+            slot_pos = None
+            if self.window is not None:
+                slot_pos = self.variable(
+                    "cache", "slot_pos",
+                    lambda: jnp.full((cache_len,), -1, jnp.int32),
+                )
             if not is_init:
                 # t == 1: one sampling step.  t > 1: batched PREFILL — the
                 # whole prompt's K/V written in one parallel pass (one
@@ -159,26 +172,48 @@ class CausalSelfAttention(nn.Module):
                 if self.use_rope:
                     pos = idx + jnp.arange(t)  # global positions
                     q, k = rope(q, pos), rope(k, pos)
-                cached_k.value = jax.lax.dynamic_update_slice(
-                    cached_k.value, k, (0, idx, 0, 0)
-                )
-                cached_v.value = jax.lax.dynamic_update_slice(
-                    cached_v.value, v, (0, idx, 0, 0)
-                )
-                cache_index.value = idx + t
-                # query i (global position idx+i) attends keys [0, idx+i]
                 q_glob = (idx + jnp.arange(t))[:, None]
-                allow = jnp.arange(total)[None, :] <= q_glob
-                if self.window is not None:
-                    # the grouped cache still holds every position, but
-                    # attention reads only the window's newest keys
-                    allow &= (
-                        jnp.arange(total)[None, :] >= q_glob - (self.window - 1)
+                if self.window is None:
+                    cached_k.value = jax.lax.dynamic_update_slice(
+                        cached_k.value, k, (0, idx, 0, 0)
                     )
-                allow = allow[None, None]  # [1, 1, t, total]
-                out = dot_product_attention(
-                    q, cached_k.value, cached_v.value, mask=allow
-                )
+                    cached_v.value = jax.lax.dynamic_update_slice(
+                        cached_v.value, v, (0, idx, 0, 0)
+                    )
+                    # query i (global position idx+i) attends keys [0, idx+i]
+                    allow = jnp.arange(total)[None, :] <= q_glob
+                    attn_k, attn_v = cached_k.value, cached_v.value
+                else:
+                    # `total` is the ring length (the STORED cache's
+                    # shape — cache_len above is only meaningful at init,
+                    # where t is the full target length).  Reads go
+                    # against [old ring ∥ this chunk]: a chunked
+                    # prefill's EARLY queries need band keys that the
+                    # chunk's own newest tokens are about to overwrite,
+                    # so the read precedes the rolling write.  Positions
+                    # are disjoint (ring < idx ≤ chunk); -1 marks
+                    # unwritten slots, never attendable.
+                    wpos = idx + jnp.arange(t)
+                    attn_k = jnp.concatenate([cached_k.value, k], axis=1)
+                    attn_v = jnp.concatenate([cached_v.value, v], axis=1)
+                    sp = jnp.concatenate([slot_pos.value, wpos])[None, :]
+                    allow = (sp >= 0) & (sp <= q_glob)
+                    allow &= sp > q_glob - self.window
+                    # rolling write: only the chunk's newest `total`
+                    # tokens can ever be read back later (slicing also
+                    # keeps the scatter indices duplicate-free)
+                    if t > total:
+                        kw, vw = k[:, -total:], v[:, -total:]
+                        wpos = wpos[-total:]
+                    else:
+                        kw, vw = k, v
+                    slots = wpos % total
+                    cached_k.value = cached_k.value.at[:, slots].set(kw)
+                    cached_v.value = cached_v.value.at[:, slots].set(vw)
+                    slot_pos.value = slot_pos.value.at[slots].set(wpos)
+                cache_index.value = idx + t
+                allow = allow[None, None]  # [1, 1, t, keys]
+                out = dot_product_attention(q, attn_k, attn_v, mask=allow)
                 return nn.DenseGeneral(
                     d, axis=(-2, -1), dtype=self.dtype, name="out"
                 )(out)
@@ -483,7 +518,17 @@ def generate(
             jax.random.PRNGKey(0), jnp.zeros((bsz, total_len), jnp.int32), train=False
         )
     )["cache"]
-    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), spec)
+
+    def _cache_leaf(path, s):
+        # zero-fill is right for K/V/index, but the windowed ring's
+        # slot_pos initializer is -1 ("unwritten, never attendable") —
+        # a zero there would masquerade as a written position-0 key
+        name = getattr(path[-1], "key", None)
+        if name == "slot_pos":
+            return jnp.full(s.shape, -1, s.dtype)
+        return jnp.zeros(s.shape, s.dtype)
+
+    cache = jax.tree_util.tree_map_with_path(_cache_leaf, spec)
     key = rng if rng is not None else jax.random.PRNGKey(0)
 
     vocab = model.vocab

@@ -851,3 +851,76 @@ def test_windowed_lm_flash_matches_dense():
     a = md.apply(variables, toks, train=False)
     b = mf.apply(variables, toks, train=False)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+
+def test_windowed_rolling_cache_is_ring_sized():
+    """The windowed decode cache holds `window` slots, not T — O(window)
+    generation memory — and a prefill longer than the window still
+    reproduces the full forward (rolling writes keep only the newest
+    window of keys)."""
+    W, T = 8, 24
+    m = lm_tiny(vocab=VOCAB, dtype=jnp.float32, window=W)
+    dm = lm_tiny(vocab=VOCAB, dtype=jnp.float32, window=W, decode=True)
+    toks = np.random.default_rng(23).integers(0, VOCAB, (2, T)).astype(np.int32)
+    variables = m.init(jax.random.PRNGKey(0), toks, train=False)
+    full = m.apply(variables, toks, train=False)
+
+    cache = dm.init(jax.random.PRNGKey(0), jnp.zeros_like(toks), train=False)["cache"]
+    attn_cache = cache["block0"]["CausalSelfAttention_0"]
+    assert attn_cache["cached_k"].shape[1] == W  # ring, not T
+    assert attn_cache["slot_pos"].shape == (W,)
+
+    # prefill 20 tokens (> W) in ONE pass, then single-token steps
+    pre, mut = dm.apply(
+        {"params": variables["params"], "cache": cache}, toks[:, :20],
+        train=False, mutable=["cache"],
+    )
+    cache = mut["cache"]
+    got = [np.asarray(pre)]
+    for t in range(20, T):
+        logits, mut = dm.apply(
+            {"params": variables["params"], "cache": cache},
+            toks[:, t : t + 1], train=False, mutable=["cache"],
+        )
+        cache = mut["cache"]
+        got.append(np.asarray(logits))
+    np.testing.assert_allclose(
+        np.asarray(full), np.concatenate(got, axis=1), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_windowed_generate_short_prompt_matches_decode():
+    """generate() with window set and a prompt SHORTER than the window:
+    its internally-built cache must mark unwritten ring slots invalid
+    (slot_pos = -1), or phantom position-0 keys pollute early steps.
+    Greedy generate must equal a hand-rolled argmax decode loop."""
+    from fluxdistributed_tpu.models import generate
+
+    W, T = 8, 16
+    m = lm_tiny(vocab=VOCAB, dtype=jnp.float32, window=W)
+    dm = lm_tiny(vocab=VOCAB, dtype=jnp.float32, window=W, decode=True)
+    toks = np.random.default_rng(29).integers(0, VOCAB, (2, 2)).astype(np.int32)
+    params = m.init(jax.random.PRNGKey(0), np.zeros((2, T), np.int32),
+                    train=False)["params"]
+
+    out = generate(dm, params, jnp.asarray(toks), total_len=T, temperature=0.0)
+
+    # hand-rolled: real init (slot_pos = -1), prefill, greedy steps
+    cache = dm.init(jax.random.PRNGKey(0), jnp.zeros((2, T), np.int32),
+                    train=False)["cache"]
+    logits, mut = dm.apply(
+        {"params": params, "cache": cache}, jnp.asarray(toks),
+        train=False, mutable=["cache"],
+    )
+    cache = mut["cache"]
+    cur = np.asarray(jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32))
+    seq = [toks[:, 0], toks[:, 1], cur]
+    for _ in range(T - 3):
+        logits, mut = dm.apply(
+            {"params": params, "cache": cache}, jnp.asarray(cur[:, None]),
+            train=False, mutable=["cache"],
+        )
+        cache = mut["cache"]
+        cur = np.asarray(jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32))
+        seq.append(cur)
+    np.testing.assert_array_equal(np.asarray(out), np.stack(seq, axis=1))
