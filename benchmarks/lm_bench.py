@@ -40,6 +40,9 @@ def main():
                     help="grouped-query attention: number of KV heads")
     ap.add_argument("--window", type=int, default=None,
                     help="sliding-window attention (newest WINDOW keys)")
+    ap.add_argument("--norm", default="layernorm",
+                    choices=["layernorm", "rmsnorm"])
+    ap.add_argument("--mlp", default="gelu", choices=["gelu", "swiglu"])
     ap.add_argument("--seconds", type=float, default=2.0)
     ap.add_argument("--platform", default=None, help="force platform (e.g. cpu)")
     args = ap.parse_args()
@@ -63,7 +66,8 @@ def main():
     model = getattr(models, args.model)(
         vocab=args.vocab, remat=args.remat,
         attn_fn=attention_core(args.attn, args.attn_block, window=args.window),
-        num_kv_heads=args.kv_heads, window=args.window)
+        num_kv_heads=args.kv_heads, window=args.window,
+        norm=args.norm, mlp=args.mlp)
     rng = np.random.default_rng(0)
     toks = rng.integers(0, args.vocab, (batch, args.seqlen)).astype(np.int32)
     params = model.init(jax.random.PRNGKey(0), toks[:1], train=False)["params"]

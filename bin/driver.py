@@ -118,6 +118,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "query attends its WINDOW newest keys (O(T*W) "
                         "attention; with --attn flash, out-of-band KV "
                         "blocks are skipped entirely)")
+    p.add_argument("--norm", default="layernorm",
+                   choices=["layernorm", "rmsnorm"],
+                   help="lm_* block norm (rmsnorm = Llama-style)")
+    p.add_argument("--mlp", default="gelu", choices=["gelu", "swiglu"],
+                   help="lm_* block MLP (swiglu = Llama-style gated)")
     p.add_argument("--sp-strategy", default="ring",
                    choices=["ring", "ulysses"],
                    help="context-parallel attention for --spmd sp: 'ring' "
@@ -292,6 +297,11 @@ def main(argv=None) -> int:
                     f"TP model-axis size ({model_k}) so the grouped kv "
                     f"projection can be head-sharded")
         attn_kwargs["num_kv_heads"] = args.kv_heads
+    if args.norm != "layernorm" or args.mlp != "gelu":
+        if not is_lm:
+            raise SystemExit("--norm/--mlp only apply to lm_* models")
+        attn_kwargs["norm"] = args.norm
+        attn_kwargs["mlp"] = args.mlp
 
     # MoE expert parallelism: the model's moe_fn closes over the mesh,
     # so the expert mesh is built BEFORE the model for this mode

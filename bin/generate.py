@@ -50,6 +50,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0, help="sampling seed")
     p.add_argument("--platform", default=None,
                    help="force platform (e.g. cpu)")
+    # architecture flags — must MATCH the training run's driver flags or
+    # the checkpoint's param tree will not fit the rebuilt decode model
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="match the trainer's --kv-heads (GQA)")
+    p.add_argument("--window", type=int, default=None,
+                   help="match the trainer's --window (rolling KV cache)")
+    p.add_argument("--norm", default="layernorm",
+                   choices=["layernorm", "rmsnorm"],
+                   help="match the trainer's --norm")
+    p.add_argument("--mlp", default="gelu", choices=["gelu", "swiglu"],
+                   help="match the trainer's --mlp")
     return p
 
 
@@ -81,8 +92,10 @@ def main(argv=None) -> int:
         )
 
     model_fn = getattr(models, args.model)
-    dm = model_fn(vocab=args.vocab, decode=True)
-    train_model = model_fn(vocab=args.vocab)
+    arch = {"num_kv_heads": args.kv_heads, "window": args.window,
+            "norm": args.norm, "mlp": args.mlp}
+    dm = model_fn(vocab=args.vocab, decode=True, **arch)
+    train_model = model_fn(vocab=args.vocab, **arch)
 
     if args.checkpoint:
         from fluxdistributed_tpu.train import load_checkpoint

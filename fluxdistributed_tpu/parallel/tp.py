@@ -172,6 +172,12 @@ def lm_tp_rules(
             return P(model_axis)
         if path.endswith("Dense_1/kernel"):
             return P(model_axis, None)
+        # SwiGLU MLP (mlp="swiglu"): gate/up column-parallel, down
+        # row-parallel — Megatron's pairing for gated MLPs (biasless)
+        if path.endswith("gate/kernel") or path.endswith("up/kernel"):
+            return P(None, model_axis)
+        if path.endswith("down/kernel"):
+            return P(model_axis, None)
         return P()
 
     return rule
