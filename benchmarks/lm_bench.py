@@ -40,6 +40,8 @@ def main():
                     help="grouped-query attention: number of KV heads")
     ap.add_argument("--window", type=int, default=None,
                     help="sliding-window attention (newest WINDOW keys)")
+    ap.add_argument("--sinks", type=int, default=0,
+                    help="StreamingLLM attention sinks (requires --window)")
     ap.add_argument("--norm", default="layernorm",
                     choices=["layernorm", "rmsnorm"])
     ap.add_argument("--mlp", default="gelu", choices=["gelu", "swiglu"])
@@ -65,8 +67,9 @@ def main():
 
     model = getattr(models, args.model)(
         vocab=args.vocab, remat=args.remat,
-        attn_fn=attention_core(args.attn, args.attn_block, window=args.window),
-        num_kv_heads=args.kv_heads, window=args.window,
+        attn_fn=attention_core(args.attn, args.attn_block,
+                               window=args.window, sinks=args.sinks),
+        num_kv_heads=args.kv_heads, window=args.window, sinks=args.sinks,
         norm=args.norm, mlp=args.mlp)
     rng = np.random.default_rng(0)
     toks = rng.integers(0, args.vocab, (batch, args.seqlen)).astype(np.int32)

@@ -118,6 +118,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "query attends its WINDOW newest keys (O(T*W) "
                         "attention; with --attn flash, out-of-band KV "
                         "blocks are skipped entirely)")
+    p.add_argument("--sinks", type=int, default=0,
+                   help="StreamingLLM attention sinks for lm_* models: the "
+                        "first SINKS keys stay attendable outside the "
+                        "window (requires --window)")
     p.add_argument("--norm", default="layernorm",
                    choices=["layernorm", "rmsnorm"],
                    help="lm_* block norm (rmsnorm = Llama-style)")
@@ -264,6 +268,12 @@ def main(argv=None) -> int:
         # the model field windows the default dense core AND the decode
         # path; a non-dense attn_fn gets its own window below
         attn_kwargs["window"] = args.window
+        if args.sinks:
+            if args.sinks < 0:
+                raise SystemExit(f"--sinks must be >= 0, got {args.sinks}")
+            attn_kwargs["sinks"] = args.sinks
+    if args.sinks and args.window is None:
+        raise SystemExit("--sinks requires --window")
     if args.attn != "dense":
         from fluxdistributed_tpu.ops import attention_core
 
@@ -275,7 +285,7 @@ def main(argv=None) -> int:
                              "(use --sp-strategy)")
         attn_kwargs["attn_fn"] = attention_core(
             args.attn, args.attn_block if args.attn_block else 128,
-            window=args.window)
+            window=args.window, sinks=args.sinks)
     if args.kv_heads is not None:
         if not is_lm:
             raise SystemExit("--kv-heads only applies to lm_* models")

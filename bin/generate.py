@@ -56,6 +56,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="match the trainer's --kv-heads (GQA)")
     p.add_argument("--window", type=int, default=None,
                    help="match the trainer's --window (rolling KV cache)")
+    p.add_argument("--sinks", type=int, default=0,
+                   help="match the trainer's --sinks (attention sinks)")
     p.add_argument("--norm", default="layernorm",
                    choices=["layernorm", "rmsnorm"],
                    help="match the trainer's --norm")
@@ -93,7 +95,7 @@ def main(argv=None) -> int:
 
     model_fn = getattr(models, args.model)
     arch = {"num_kv_heads": args.kv_heads, "window": args.window,
-            "norm": args.norm, "mlp": args.mlp}
+            "sinks": args.sinks, "norm": args.norm, "mlp": args.mlp}
     dm = model_fn(vocab=args.vocab, decode=True, **arch)
     train_model = model_fn(vocab=args.vocab, **arch)
 

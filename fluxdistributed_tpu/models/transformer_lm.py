@@ -107,6 +107,7 @@ class CausalSelfAttention(nn.Module):
     decode: bool = False
     num_kv_heads: Optional[int] = None  # GQA: None/num_heads → MHA
     window: Optional[int] = None  # sliding-window attention (causal)
+    sinks: int = 0  # StreamingLLM attention sinks (first `sinks` keys)
 
     @nn.compact
     def __call__(self, x):
@@ -152,11 +153,15 @@ class CausalSelfAttention(nn.Module):
         if self.decode:
             is_init = not self.has_variable("cache", "cached_k")
             # at init, t is the FULL target length -> static cache shape.
-            # With a window the cache is a ROLLING ring of `window` slots
-            # (O(window) memory regardless of generation length); slot
-            # positions live in a side buffer so the mask can recover
-            # global causality after wraparound.
-            cache_len = t if self.window is None else min(self.window, t)
+            # With a window the cache is `sinks` PINNED slots plus a
+            # ROLLING ring of `window` slots (O(sinks + window) memory
+            # regardless of generation length); slot positions live in a
+            # side buffer so the mask can recover global causality after
+            # wraparound.
+            cache_len = (
+                t if self.window is None
+                else min(self.window + self.sinks, t)
+            )
             cached_k = self.variable(
                 "cache", "cached_k", jnp.zeros,
                 (b, cache_len, hkv, head_dim), k.dtype,
@@ -209,19 +214,32 @@ class CausalSelfAttention(nn.Module):
                     attn_v = jnp.concatenate([cached_v.value, v], axis=1)
                     sp = jnp.concatenate([slot_pos.value, wpos])[None, :]
                     allow = (sp >= 0) & (sp <= q_glob)
-                    allow &= sp > q_glob - self.window
-                    # rolling write: only the chunk's newest `total`
-                    # tokens can ever be read back later (slicing also
-                    # keeps the scatter indices duplicate-free)
-                    if t > total:
-                        kw, vw = k[:, -total:], v[:, -total:]
-                        wpos = wpos[-total:]
+                    in_band = sp > q_glob - self.window
+                    if self.sinks:
+                        in_band |= sp < self.sinks
+                    allow &= in_band
+                    # write layout: position p lives at slot p while
+                    # p < sinks (pinned, never evicted), else at
+                    # sinks + (p - sinks) % ring.  Only sink positions
+                    # and the chunk's newest `ring` tokens survive a
+                    # read-back, so everything else routes to the
+                    # out-of-range slot and mode="drop" discards it —
+                    # this also keeps the scatter duplicate-free.
+                    ring = max(total - self.sinks, 1)
+                    keep = wpos > idx + t - 1 - ring
+                    if self.sinks:
+                        keep |= wpos < self.sinks
+                        ring_slot = self.sinks + (wpos - self.sinks) % ring
+                        slot = jnp.where(wpos < self.sinks, wpos, ring_slot)
                     else:
-                        kw, vw = k, v
-                    slots = wpos % total
-                    cached_k.value = cached_k.value.at[:, slots].set(kw)
-                    cached_v.value = cached_v.value.at[:, slots].set(vw)
-                    slot_pos.value = slot_pos.value.at[slots].set(wpos)
+                        slot = wpos % ring
+                    slots = jnp.where(keep, slot, total)  # total = dropped
+                    cached_k.value = cached_k.value.at[:, slots].set(
+                        k, mode="drop")
+                    cached_v.value = cached_v.value.at[:, slots].set(
+                        v, mode="drop")
+                    slot_pos.value = slot_pos.value.at[slots].set(
+                        wpos, mode="drop")
                 cache_index.value = idx + t
                 allow = allow[None, None]  # [1, 1, t, keys]
                 out = dot_product_attention(q, attn_k, attn_v, mask=allow)
@@ -238,7 +256,7 @@ class CausalSelfAttention(nn.Module):
             self.attn_fn
             if self.attn_fn is not None
             else partial(dot_product_attention, causal=True,
-                         window=self.window)
+                         window=self.window, sinks=self.sinks)
         )
         # a custom attn_fn owns its own windowing (attention_core(...,
         # window=...) builds one); the model only windows the defaults
@@ -256,6 +274,7 @@ class DecoderBlock(nn.Module):
     decode: bool = False
     num_kv_heads: Optional[int] = None
     window: Optional[int] = None
+    sinks: int = 0
     norm: str = "layernorm"
     mlp: str = "gelu"
 
@@ -268,6 +287,7 @@ class DecoderBlock(nn.Module):
             self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn,
             use_rope=self.use_rope, decode=self.decode,
             num_kv_heads=self.num_kv_heads, window=self.window,
+            sinks=self.sinks,
         )(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -318,6 +338,7 @@ class MoEDecoderBlock(nn.Module):
     decode: bool = False
     num_kv_heads: Optional[int] = None
     window: Optional[int] = None
+    sinks: int = 0
     norm: str = "layernorm"
 
     @nn.compact
@@ -327,6 +348,7 @@ class MoEDecoderBlock(nn.Module):
             self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn,
             use_rope=self.use_rope, decode=self.decode,
             num_kv_heads=self.num_kv_heads, window=self.window,
+            sinks=self.sinks,
         )(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -377,6 +399,7 @@ class TransformerLM(nn.Module):
     decode: bool = False
     num_kv_heads: Optional[int] = None  # GQA: grouped KV heads
     window: Optional[int] = None  # sliding-window attention
+    sinks: int = 0  # StreamingLLM attention sinks (with window)
     norm: str = "layernorm"  # layernorm | rmsnorm
     mlp: str = "gelu"  # gelu | swiglu (MoE blocks keep their expert MLP)
     # rematerialize each block in the backward pass: activations for only
@@ -435,7 +458,8 @@ class TransformerLM(nn.Module):
                     self.moe_fn, dtype=self.dtype, dropout=self.dropout,
                     attn_fn=self.attn_fn, use_rope=self.use_rope,
                     decode=self.decode, num_kv_heads=self.num_kv_heads,
-                    window=self.window, norm=self.norm, name=f"block{i}",
+                    window=self.window, sinks=self.sinks, norm=self.norm,
+                    name=f"block{i}",
                 )(x, train)
             else:
                 x = block_cls(
@@ -443,7 +467,8 @@ class TransformerLM(nn.Module):
                     dropout=self.dropout, attn_fn=self.attn_fn,
                     use_rope=self.use_rope, decode=self.decode,
                     num_kv_heads=self.num_kv_heads, window=self.window,
-                    norm=self.norm, mlp=self.mlp, name=f"block{i}",
+                    sinks=self.sinks, norm=self.norm, mlp=self.mlp,
+                    name=f"block{i}",
                 )(x, train)
         x = _norm_layer(self.norm, self.dtype, name="final_ln")(x)
         if self.tie_embeddings:
@@ -654,7 +679,7 @@ def _pp_validate_and_stage(model: "TransformerLM", mesh, pipe_axis: str, what: s
         model.num_heads, model.mlp_dim, dtype=model.dtype,
         dropout=0.0, use_rope=model.use_rope, attn_fn=model.attn_fn,
         num_kv_heads=model.num_kv_heads, window=model.window,
-        norm=model.norm, mlp=model.mlp,
+        sinks=model.sinks, norm=model.norm, mlp=model.mlp,
     )
 
     def base_fn(p, x):

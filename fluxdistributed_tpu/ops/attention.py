@@ -35,7 +35,8 @@ __all__ = [
 NEG_INF = -1e30
 
 
-def attention_core(kind: str, block: int = 128, window: Optional[int] = None):
+def attention_core(kind: str, block: int = 128, window: Optional[int] = None,
+                   sinks: int = 0):
     """Resolve an ``--attn``-style core name to a causal ``attn_fn``.
 
     The single source of the dense/blockwise/flash wiring shared by
@@ -49,20 +50,23 @@ def attention_core(kind: str, block: int = 128, window: Optional[int] = None):
 
     if window is not None and window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
+    if sinks and window is None:
+        raise ValueError("sinks only make sense with a window")
     if kind == "dense":
         if window is None:
             return None
-        return partial(dot_product_attention, causal=True, window=window)
+        return partial(dot_product_attention, causal=True, window=window,
+                       sinks=sinks)
     if block <= 0:
         raise ValueError(f"attention block size must be > 0, got {block}")
     if kind == "blockwise":
         return partial(blockwise_attention, block_size=block, causal=True,
-                       window=window)
+                       window=window, sinks=sinks)
     if kind == "flash":
         from .pallas_attention import flash_attention
 
         return partial(flash_attention, causal=True, block_q=block,
-                       block_k=block, window=window)
+                       block_k=block, window=window, sinks=sinks)
     raise ValueError(f"unknown attention core {kind!r}")
 
 
@@ -96,6 +100,7 @@ def dot_product_attention(
     causal: bool = False,
     mask: Optional[jax.Array] = None,
     window: Optional[int] = None,
+    sinks: int = 0,
 ) -> jax.Array:
     """Reference softmax attention, one XLA fusion.
 
@@ -111,6 +116,8 @@ def dot_product_attention(
     """
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
+    if sinks and window is None:
+        raise ValueError("sinks only make sense with a window")
     k, v = _expand_kv(q, k, v)
     q = _scale(q)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
@@ -119,10 +126,15 @@ def dot_product_attention(
     if causal:
         # Align ends: allows Tq != Tk (e.g. decoding with a KV cache).
         idx_q = jnp.arange(tq)[:, None] + (tk - tq)
-        allow = jnp.arange(tk)[None, :] <= idx_q
+        causal_ok = jnp.arange(tk)[None, :] <= idx_q
+        allow = causal_ok
         if window is not None:
-            # sliding window: each query sees its `window` newest keys
-            allow &= jnp.arange(tk)[None, :] >= idx_q - (window - 1)
+            # sliding window: each query sees its `window` newest keys,
+            # plus the first `sinks` positions (StreamingLLM sinks)
+            in_band = jnp.arange(tk)[None, :] >= idx_q - (window - 1)
+            if sinks:
+                in_band |= jnp.arange(tk)[None, :] < sinks
+            allow &= in_band
         allow = allow[None, None]
     if mask is not None:
         allow = mask if allow is None else allow & mask
@@ -230,6 +242,7 @@ def blockwise_attention(
     block_size: int = 512,
     causal: bool = False,
     window: Optional[int] = None,
+    sinks: int = 0,
 ) -> jax.Array:
     """Flash-style attention via ``lax.scan`` over KV blocks.
 
@@ -244,6 +257,8 @@ def blockwise_attention(
     """
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
+    if sinks and window is None:
+        raise ValueError("sinks only make sense with a window")
     k, v = _expand_kv(q, k, v)
     b, tq, h, d = q.shape
     tk = k.shape[1]
@@ -266,7 +281,10 @@ def blockwise_attention(
         if causal:
             mask &= k_pos[None, :] <= q_pos[:, None]
             if window is not None:
-                mask &= k_pos[None, :] >= q_pos[:, None] - (window - 1)
+                in_band = k_pos[None, :] >= q_pos[:, None] - (window - 1)
+                if sinks:
+                    in_band |= k_pos[None, :] < sinks
+                mask &= in_band
         elif not pad:
             mask = None
         return attn_block_update(carry, q_scaled, k_blk, v_blk, mask=mask), None

@@ -57,24 +57,29 @@ _LSE_PAD = 1e30
 
 
 def _block_relevant(q_start, k_start, block_q, block_k,
-                    causal, causal_offset, window):
+                    causal, causal_offset, window, sinks):
     """Static-shape predicate: does KV block ``kj`` intersect the causal
     (and sliding-window) band of Q block ``qi`` at all?  False blocks are
     skipped with ``pl.when`` — with a window this is where the FLOPs
-    saving comes from: far-past KV blocks never touch the MXU."""
+    saving comes from: far-past KV blocks never touch the MXU.  ``sinks``
+    (attention sinks, StreamingLLM-style) keeps the first ``sinks`` key
+    positions attendable from everywhere, so their blocks stay live."""
     cond = True
     if causal:
         # any (q, k) with k <= q + offset?
         cond = k_start <= q_start + block_q - 1 + causal_offset
         if window is not None:
             # any (q, k) with k >= q + offset - (window-1)?
-            cond &= (k_start + block_k - 1
-                     >= q_start + causal_offset - (window - 1))
+            in_band = (k_start + block_k - 1
+                       >= q_start + causal_offset - (window - 1))
+            if sinks:
+                in_band |= k_start < sinks  # sink blocks never go dead
+            cond &= in_band
     return cond
 
 
 def _band_mask(s_shape, q_start, k_start, *,
-               causal, tk_valid, causal_offset, window, padded):
+               causal, tk_valid, causal_offset, window, padded, sinks):
     """The shared fwd/bwd attend-mask for one [block_q, block_k] tile
     (None when every position is attendable)."""
     if not (causal or padded):
@@ -83,15 +88,20 @@ def _band_mask(s_shape, q_start, k_start, *,
     mask = k_pos < tk_valid
     if causal:
         q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s_shape, 0)
-        mask &= k_pos <= q_pos + causal_offset
+        causal_ok = k_pos <= q_pos + causal_offset
+        mask &= causal_ok
         if window is not None:
-            mask &= k_pos >= q_pos + causal_offset - (window - 1)
+            in_band = k_pos >= q_pos + causal_offset - (window - 1)
+            if sinks:
+                # sinks stay attendable (still causally: causal_ok above)
+                in_band |= k_pos < sinks
+            mask &= in_band
     return mask
 
 
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, scale, causal, tk_valid, causal_offset, padded, window,
+    *, scale, causal, tk_valid, causal_offset, padded, window, sinks,
 ):
     """``causal_offset = Tk_valid - Tq_valid`` end-aligns the causal mask
     (query i attends keys <= i + offset), matching
@@ -128,6 +138,7 @@ def _flash_kernel(
         mask = _band_mask(
             s.shape, q_start, k_start, causal=causal, tk_valid=tk_valid,
             causal_offset=causal_offset, window=window, padded=padded,
+            sinks=sinks,
         )
         p, corr, m_new, l_new = online_softmax_update(
             s, m_ref[:, 0], l_ref[:, 0], mask=mask
@@ -142,7 +153,8 @@ def _flash_kernel(
     if causal:
         # Skip KV blocks entirely outside the causal/window band.
         pl.when(_block_relevant(
-            q_start, k_start, block_q, block_k, causal, causal_offset, window
+            q_start, k_start, block_q, block_k, causal, causal_offset,
+            window, sinks,
         ))(_body)
     else:
         _body()
@@ -158,7 +170,7 @@ def _flash_kernel(
 
 def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
               *, scale, causal, tk_valid, causal_offset, padded, window,
-              q_start, k_start):
+              sinks, q_start, k_start):
     """Shared dQ/dKV tile recompute: returns (p, ds), both [bq, bk] f32.
 
     ``p`` is the exact forward block softmax, rebuilt from LSE;
@@ -183,6 +195,7 @@ def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     mask = _band_mask(
         s.shape, q_start, k_start, causal=causal, tk_valid=tk_valid,
         causal_offset=causal_offset, window=window, padded=padded,
+        sinks=sinks,
     )
     if mask is not None:
         p = jnp.where(mask, p, 0.0)
@@ -195,7 +208,7 @@ def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc_ref,
-    *, scale, causal, tk_valid, causal_offset, padded, window,
+    *, scale, causal, tk_valid, causal_offset, padded, window, sinks,
 ):
     _, block_q, _ = q_ref.shape
     _, block_k, _ = k_ref.shape
@@ -215,7 +228,7 @@ def _flash_dq_kernel(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             scale=scale, causal=causal, tk_valid=tk_valid,
             causal_offset=causal_offset, padded=padded, window=window,
-            q_start=q_start, k_start=k_start,
+            sinks=sinks, q_start=q_start, k_start=k_start,
         )
         k = k_ref[0]
         dq_acc_ref[:] += scale * jax.lax.dot_general(
@@ -225,7 +238,8 @@ def _flash_dq_kernel(
 
     if causal:
         pl.when(_block_relevant(
-            q_start, k_start, block_q, block_k, causal, causal_offset, window
+            q_start, k_start, block_q, block_k, causal, causal_offset,
+            window, sinks,
         ))(_body)
     else:
         _body()
@@ -238,7 +252,7 @@ def _flash_dq_kernel(
 def _flash_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc_ref, dv_acc_ref,
-    *, scale, causal, tk_valid, causal_offset, padded, nq, window,
+    *, scale, causal, tk_valid, causal_offset, padded, nq, window, sinks,
 ):
     """Inner grid axis t = member * nq + qi: with GQA, each KV head's
     accumulator folds the q-blocks of all `group` query heads sharing
@@ -263,7 +277,7 @@ def _flash_dkv_kernel(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             scale=scale, causal=causal, tk_valid=tk_valid,
             causal_offset=causal_offset, padded=padded, window=window,
-            q_start=q_start, k_start=k_start,
+            sinks=sinks, q_start=q_start, k_start=k_start,
         )
         do = do_ref[0]
         q = q_ref[0]
@@ -278,7 +292,8 @@ def _flash_dkv_kernel(
 
     if causal:
         pl.when(_block_relevant(
-            q_start, k_start, block_q, block_k, causal, causal_offset, window
+            q_start, k_start, block_q, block_k, causal, causal_offset,
+            window, sinks,
         ))(_body)
     else:
         _body()
@@ -321,10 +336,11 @@ def _gqa_dims(q, k):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "block_q", "block_k", "interpret", "window"),
+    static_argnames=("causal", "block_q", "block_k", "interpret", "window",
+                     "sinks"),
 )
 def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
-                    window=None):
+                    window=None, sinks=0):
     b, tq, h, d = q.shape
     tk = k.shape[1]
     h, hkv, group = _gqa_dims(q, k)
@@ -344,7 +360,7 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
     grid = (b * h, tq_p // block_q, tk_p // block_k)
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, tk_valid=tk,
-        causal_offset=tk - tq, padded=tk_p != tk, window=window,
+        causal_offset=tk - tq, padded=tk_p != tk, window=window, sinks=sinks,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -374,10 +390,11 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "block_q", "block_k", "interpret", "window"),
+    static_argnames=("causal", "block_q", "block_k", "interpret", "window",
+                     "sinks"),
 )
 def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
-                    g_lse=None, window=None):
+                    g_lse=None, window=None, sinks=0):
     b, tq, h, d = q.shape
     tk = k.shape[1]
     h, hkv, group = _gqa_dims(q, k)
@@ -430,7 +447,7 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
 
     common = dict(
         scale=scale, causal=causal, tk_valid=tk, causal_offset=tk - tq,
-        padded=tk_p != tk, window=window,
+        padded=tk_p != tk, window=window, sinks=sinks,
     )
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, **common),
@@ -470,7 +487,7 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -479,6 +496,7 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     window: int | None = None,
+    sinks: int = 0,
 ) -> jax.Array:
     """Fused flash attention, [B, T, H, D] → [B, T, H, D].
 
@@ -489,32 +507,48 @@ def flash_attention(
     natively (never repeated in HBM).  ``window`` (requires ``causal``)
     restricts each query to its ``window`` most recent keys — KV blocks
     outside the band are SKIPPED, so long-T cost is O(T·window), not
-    O(T²).
+    O(T²).  ``sinks`` (StreamingLLM attention sinks; needs ``window``)
+    keeps the first ``sinks`` key positions always attendable — their
+    blocks stay live while everything between sink and band is skipped.
     """
+    _validate_window(causal, window, sinks)
+    interpret = jax.default_backend() != "tpu"
+    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+                             window=window, sinks=sinks)
+    return out
+
+
+def _validate_window(causal, window, sinks):
     if window is not None and not causal:
         raise ValueError("window requires causal=True (sliding-window "
                          "attention is a causal-LM construct)")
     if window is not None and window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
-    interpret = jax.default_backend() != "tpu"
-    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
-                             window=window)
-    return out
+    if sinks:
+        if sinks < 0:
+            raise ValueError(f"sinks must be >= 0, got {sinks}")
+        if window is None:
+            raise ValueError("sinks only make sense with a window "
+                             "(unwindowed causal attention already "
+                             "attends every past position)")
 
 
-def _fwd(q, k, v, causal, block_q, block_k, window):
+def _fwd(q, k, v, causal, block_q, block_k, window, sinks):
+    # custom_vjp skips the primal body under jax.grad — re-validate here
+    # or invalid combos would silently trace through in training steps
+    _validate_window(causal, window, sinks)
     interpret = jax.default_backend() != "tpu"
     out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
-                               window=window)
+                               window=window, sinks=sinks)
     return out, (q, k, v, out, lse)
 
 
-def _bwd(causal, block_q, block_k, window, res, g):
+def _bwd(causal, block_q, block_k, window, sinks, res, g):
     q, k, v, o, lse = res
     interpret = jax.default_backend() != "tpu"
     return _flash_bwd_impl(
         q, k, v, o, lse, g, causal, block_q, block_k, interpret,
-        window=window,
+        window=window, sinks=sinks,
     )
 
 

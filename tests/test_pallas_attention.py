@@ -283,3 +283,38 @@ def test_flash_in_vit():
     a = m_ref.apply(variables, x, train=False)
     b = m_flash.apply(variables, x, train=False)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("window,sinks", [(8, 2), (12, 8), (16, 1)])
+def test_flash_attention_sinks_match_reference(window, sinks):
+    """StreamingLLM sinks: first `sinks` keys stay attendable outside
+    the window; parity with the windowed+sinked dense core fwd AND bwd
+    (T=48 ensures band, sink, and dead regions all exist)."""
+    q, k, v = _qkv(t=48)
+    ref = dot_product_attention(q, k, v, causal=True, window=window, sinks=sinks)
+    out = flash_attention(q, k, v, True, 16, 16, window, sinks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    gf = jax.grad(
+        lambda q, k, v: (
+            flash_attention(q, k, v, True, 16, 16, window, sinks) ** 2
+        ).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gr = jax.grad(
+        lambda q, k, v: (
+            dot_product_attention(
+                q, k, v, causal=True, window=window, sinks=sinks) ** 2
+        ).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_sinks_require_window():
+    q, k, v = _qkv()
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, k, v, True, 16, 16, None, 2)
+    with pytest.raises(ValueError, match="window"):
+        dot_product_attention(q, k, v, causal=True, sinks=2)
