@@ -17,7 +17,7 @@ accepted.  Load .pt/.pth files with ``load_torch_file`` (requires torch).
 
 from __future__ import annotations
 
-from typing import Any, Mapping
+from typing import Any, Mapping, Optional
 
 import numpy as np
 
@@ -25,6 +25,7 @@ __all__ = [
     "import_torch_resnet",
     "import_torch_vit",
     "import_torch_convnext",
+    "import_gpt2",
     "load_torch_file",
 ]
 
@@ -184,6 +185,80 @@ def import_torch_vit(
         i += 1
     if i == 0:
         raise ValueError("no encoder layers found — not a torchvision ViT state_dict")
+    return params, {}
+
+
+def import_gpt2(
+    state_dict: Mapping[str, Any], num_heads: int, seqlen: Optional[int] = None
+) -> tuple[dict, dict]:
+    """Convert a HuggingFace ``GPT2LMHeadModel`` state_dict to
+    ``(params, model_state)`` for a :class:`TransformerLM` built with
+    ``use_rope=False, tie_embeddings=True, dtype=float32`` and matching
+    ``depth/dim/num_heads/mlp_dim`` (GPT-2 is pre-LN, tanh-GELU, tied
+    embeddings — exactly the framework LM with learned positions).
+
+    HF ``Conv1D`` stores weights as ``[in, out]`` (already the flax
+    orientation — no transpose, unlike ``nn.Linear``); ``c_attn`` packs
+    ``[q|k|v]`` along the output dim.  ``seqlen`` slices the positional
+    table (``wpe``) to the target context length (default: full table —
+    the model must then be applied at exactly that length).
+
+    ``model_state`` is ``{}`` — the LM has no mutable collections.
+    """
+    # accept both GPT2LMHeadModel ("transformer.h...") and bare
+    # GPT2Model ("h...") key layouts
+    pre = "transformer." if "transformer.wte.weight" in state_dict else ""
+    if f"{pre}wte.weight" not in state_dict:
+        raise ValueError("not a GPT-2 state_dict (no wte.weight)")
+    wte = _np(state_dict[f"{pre}wte.weight"])
+    wpe = _np(state_dict[f"{pre}wpe.weight"])
+    d = wte.shape[1]
+    if d % num_heads:
+        raise ValueError(f"embed dim {d} not divisible by num_heads {num_heads}")
+    hd = d // num_heads
+    if seqlen is not None:
+        if seqlen > wpe.shape[0]:
+            raise ValueError(
+                f"seqlen {seqlen} exceeds the checkpoint's positional "
+                f"table ({wpe.shape[0]})")
+        wpe = wpe[:seqlen]
+
+    params: dict = {
+        "embed": {"embedding": wte},
+        "pos_embedding": wpe,
+        "final_ln": _ln(state_dict, f"{pre}ln_f"),
+    }
+    i = 0
+    while f"{pre}h.{i}.ln_1.weight" in state_dict:
+        t = f"{pre}h.{i}"
+        w_qkv = _np(state_dict[f"{t}.attn.c_attn.weight"])  # [d, 3d]
+        b_qkv = _np(state_dict[f"{t}.attn.c_attn.bias"])  # [3d]
+        w_out = _np(state_dict[f"{t}.attn.c_proj.weight"])  # [d, d]
+        params[f"block{i}"] = {
+            "LayerNorm_0": _ln(state_dict, f"{t}.ln_1"),
+            "CausalSelfAttention_0": {
+                "qkv": {
+                    "kernel": w_qkv.reshape(d, 3, num_heads, hd),
+                    "bias": b_qkv.reshape(3, num_heads, hd),
+                },
+                "out": {
+                    "kernel": w_out.reshape(num_heads, hd, d),
+                    "bias": _np(state_dict[f"{t}.attn.c_proj.bias"]),
+                },
+            },
+            "LayerNorm_1": _ln(state_dict, f"{t}.ln_2"),
+            "Dense_0": {
+                "kernel": _np(state_dict[f"{t}.mlp.c_fc.weight"]),
+                "bias": _np(state_dict[f"{t}.mlp.c_fc.bias"]),
+            },
+            "Dense_1": {
+                "kernel": _np(state_dict[f"{t}.mlp.c_proj.weight"]),
+                "bias": _np(state_dict[f"{t}.mlp.c_proj.bias"]),
+            },
+        }
+        i += 1
+    if i == 0:
+        raise ValueError("no transformer blocks found — not a GPT-2 state_dict")
     return params, {}
 
 

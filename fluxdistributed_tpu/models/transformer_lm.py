@@ -77,14 +77,16 @@ def rope(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax.Array
     return out.reshape(x.shape).astype(x.dtype)
 
 
-def _norm_layer(kind: str, dtype, name: Optional[str] = None):
+def _norm_layer(kind: str, dtype, name: Optional[str] = None,
+                eps: float = 1e-6):
     """``layernorm`` (GPT-2 style, default) or ``rmsnorm`` (Llama
     style: no mean-centering, no bias — one fewer reduction per norm on
-    the VPU and a smaller param tree)."""
+    the VPU and a smaller param tree).  ``eps`` matters for weight
+    interop: HF GPT-2 uses 1e-5 where flax defaults to 1e-6."""
     if kind == "layernorm":
-        return nn.LayerNorm(dtype=dtype, name=name)
+        return nn.LayerNorm(dtype=dtype, name=name, epsilon=eps)
     if kind == "rmsnorm":
-        return nn.RMSNorm(dtype=dtype, name=name)
+        return nn.RMSNorm(dtype=dtype, name=name, epsilon=eps)
     raise ValueError(f"unknown norm {kind!r} (layernorm|rmsnorm)")
 
 
@@ -277,12 +279,13 @@ class DecoderBlock(nn.Module):
     sinks: int = 0
     norm: str = "layernorm"
     mlp: str = "gelu"
+    norm_eps: float = 1e-6
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         # train is positional-or-keyword (unlike the package's other
         # blocks) so nn.remat can mark it static via static_argnums
-        y = _norm_layer(self.norm, self.dtype)(x)
+        y = _norm_layer(self.norm, self.dtype, eps=self.norm_eps)(x)
         y = CausalSelfAttention(
             self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn,
             use_rope=self.use_rope, decode=self.decode,
@@ -291,7 +294,7 @@ class DecoderBlock(nn.Module):
         )(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
-        y = _norm_layer(self.norm, self.dtype)(x)
+        y = _norm_layer(self.norm, self.dtype, eps=self.norm_eps)(x)
         d = x.shape[-1]
         if self.mlp == "swiglu":
             # Llama-style gated MLP: gate/up column matmuls fused by XLA,
@@ -340,10 +343,11 @@ class MoEDecoderBlock(nn.Module):
     window: Optional[int] = None
     sinks: int = 0
     norm: str = "layernorm"
+    norm_eps: float = 1e-6
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        y = _norm_layer(self.norm, self.dtype)(x)
+        y = _norm_layer(self.norm, self.dtype, eps=self.norm_eps)(x)
         y = CausalSelfAttention(
             self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn,
             use_rope=self.use_rope, decode=self.decode,
@@ -352,7 +356,7 @@ class MoEDecoderBlock(nn.Module):
         )(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
-        y = _norm_layer(self.norm, self.dtype)(x)
+        y = _norm_layer(self.norm, self.dtype, eps=self.norm_eps)(x)
         b, t, d = y.shape
         e, m = self.num_experts, self.mlp_dim
         init = nn.initializers.lecun_normal()
@@ -401,6 +405,7 @@ class TransformerLM(nn.Module):
     window: Optional[int] = None  # sliding-window attention
     sinks: int = 0  # StreamingLLM attention sinks (with window)
     norm: str = "layernorm"  # layernorm | rmsnorm
+    norm_eps: float = 1e-6  # 1e-5 for HF GPT-2 weight interop
     mlp: str = "gelu"  # gelu | swiglu (MoE blocks keep their expert MLP)
     # rematerialize each block in the backward pass: activations for only
     # ~one block live at a time, trading ~1 extra forward of FLOPs for
@@ -459,7 +464,7 @@ class TransformerLM(nn.Module):
                     attn_fn=self.attn_fn, use_rope=self.use_rope,
                     decode=self.decode, num_kv_heads=self.num_kv_heads,
                     window=self.window, sinks=self.sinks, norm=self.norm,
-                    name=f"block{i}",
+                    norm_eps=self.norm_eps, name=f"block{i}",
                 )(x, train)
             else:
                 x = block_cls(
@@ -468,9 +473,9 @@ class TransformerLM(nn.Module):
                     use_rope=self.use_rope, decode=self.decode,
                     num_kv_heads=self.num_kv_heads, window=self.window,
                     sinks=self.sinks, norm=self.norm, mlp=self.mlp,
-                    name=f"block{i}",
+                    norm_eps=self.norm_eps, name=f"block{i}",
                 )(x, train)
-        x = _norm_layer(self.norm, self.dtype, name="final_ln")(x)
+        x = _norm_layer(self.norm, self.dtype, name="final_ln", eps=self.norm_eps)(x)
         if self.tie_embeddings:
             logits = embed.attend(x)  # h @ E^T
         else:
@@ -680,6 +685,7 @@ def _pp_validate_and_stage(model: "TransformerLM", mesh, pipe_axis: str, what: s
         dropout=0.0, use_rope=model.use_rope, attn_fn=model.attn_fn,
         num_kv_heads=model.num_kv_heads, window=model.window,
         sinks=model.sinks, norm=model.norm, mlp=model.mlp,
+        norm_eps=model.norm_eps,
     )
 
     def base_fn(p, x):
@@ -770,7 +776,7 @@ def lm_pp(
         batch_axis=batch_axis, remat=remat,
     )
     embed = nn.Embed(model.vocab, model.dim, dtype=model.dtype)
-    ln = _norm_layer(model.norm, model.dtype)
+    ln = _norm_layer(model.norm, model.dtype, eps=model.norm_eps)
     split_params = _pp_split_params(model, mesh, pipe_axis, S, V)
 
     def loss_fn(params, model_state, batch, train: bool, rng=None):
@@ -849,7 +855,7 @@ def lm_pp_1f1b(
     S, V, stage_fn = _pp_validate_and_stage(
         model, mesh, pipe_axis, "lm_pp_1f1b", blocked=not interleave)
     embed = nn.Embed(model.vocab, model.dim, dtype=model.dtype)
-    ln = _norm_layer(model.norm, model.dtype)
+    ln = _norm_layer(model.norm, model.dtype, eps=model.norm_eps)
 
     def embed_fn(outer, tokens_mb):
         return embed.apply({"params": outer["embed"]}, tokens_mb)

@@ -195,3 +195,40 @@ def test_logit_parity_s2d_stem():
         model.apply({"params": params, **mstate}, space_to_depth(x), train=False)
     )
     np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_gpt2_logit_parity():
+    """HF transformers GPT-2 (random init — no network needed) ->
+    TransformerLM(use_rope=False, norm_eps=1e-5): exact logit parity.
+    Validates the Conv1D (no-transpose) qkv/mlp mapping, the learned
+    positional table slice, tied embeddings, and the LN epsilon."""
+    transformers = pytest.importorskip("transformers")
+
+    from fluxdistributed_tpu.models import import_gpt2
+    from fluxdistributed_tpu.models.transformer_lm import TransformerLM
+
+    torch.manual_seed(0)
+    cfg = transformers.GPT2Config(
+        vocab_size=100, n_positions=32, n_embd=48, n_layer=2, n_head=3,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    hm = transformers.GPT2LMHeadModel(cfg).eval()
+    params, mstate = import_gpt2(hm.state_dict(), num_heads=3, seqlen=32)
+    assert mstate == {}
+
+    m = TransformerLM(
+        vocab=100, depth=2, dim=48, num_heads=3, mlp_dim=192,
+        dtype=jnp.float32, dropout=0.0, use_rope=False, norm_eps=1e-5,
+    )
+    toks = np.random.default_rng(0).integers(0, 100, (2, 32)).astype(np.int32)
+    with torch.no_grad():
+        ref = hm(torch.from_numpy(toks.astype(np.int64))).logits.numpy()
+    out = np.asarray(m.apply({"params": params}, jnp.asarray(toks), train=False))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt2_import_rejects_non_gpt2():
+    from fluxdistributed_tpu.models import import_gpt2
+
+    with pytest.raises(ValueError, match="wte"):
+        import_gpt2({"foo": 1}, num_heads=2)
