@@ -220,7 +220,6 @@ def ulysses_attention(
     """
     from ..ops.attention import dot_product_attention
 
-    k, v = _expand_kv(q, k, v)  # grouped KV → query head count
     axis_size = jax.lax.psum(1, axis_name)
     assert q.shape[2] % axis_size == 0, (
         f"'{axis_name}' axis size {axis_size} must divide num_heads {q.shape[2]}"
@@ -230,7 +229,17 @@ def ulysses_attention(
         jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
         tiled=True,
     )
-    qg, kg, vg = gather(q), gather(k), gather(v)
+    if k.shape[2] != q.shape[2] and k.shape[2] % axis_size == 0:
+        # grouped KV rides the all_to_all at hkv heads (the GQA comm
+        # saving) and is expanded only AFTER the re-shard
+        qg, kg, vg = gather(q), gather(k), gather(v)
+        kg, vg = _expand_kv(qg, kg, vg)
+    else:
+        # MHA, or hkv not divisible by the axis (the tiled head re-shard
+        # needs equal chunks per rank): expand first — correct, just
+        # without the grouped-comm saving
+        k, v = _expand_kv(q, k, v)
+        qg, kg, vg = gather(q), gather(k), gather(v)
     out = dot_product_attention(qg, kg, vg, causal=causal)
     # [B, T, H/P, D] → [B, T/P, H, D]
     return jax.lax.all_to_all(

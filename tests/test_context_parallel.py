@@ -168,3 +168,46 @@ def test_vit_with_ring_attention(data_seq_mesh):
     a = m_ref.apply(variables, x, train=False)
     b = fwd(variables, x)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("hkv", [2, 4, 8])
+def test_ulysses_gqa_matches_single_device(seq_mesh, hkv):
+    """Ulysses with grouped KV on the 8-way mesh: hkv in {2, 4} takes
+    the expand-first fallback (hkv % 8 != 0) and hkv=8 is plain MHA —
+    all must equal single-device GQA attention.  The GROUPED-comm branch
+    is pinned separately by test_ulysses_gqa_grouped_comm_branch."""
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (2, 64, 8, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 64, hkv, 16), jnp.float32)
+    v = jax.random.normal(ks[2], (2, 64, hkv, 16), jnp.float32)
+    ref = dot_product_attention(q, k, v, causal=True)
+    attn = make_ulysses_attention(seq_mesh, causal=True)
+    out = jax.jit(attn)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_gqa_grouped_comm_branch(data_seq_mesh):
+    """seq axis 4 with hkv=4 < h=8: the GROUPED all_to_all branch (hkv %
+    axis == 0 while hkv != h) — KV re-shards at hkv heads and expands
+    only after."""
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (2, 64, 8, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 64, 4, 16), jnp.float32)
+    v = jax.random.normal(ks[2], (2, 64, 4, 16), jnp.float32)
+    ref = dot_product_attention(q, k, v, causal=True)
+    attn = make_ulysses_attention(data_seq_mesh, batch_axis="data", causal=True)
+    out = jax.jit(attn)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_gqa_matches_single_device(seq_mesh):
+    """Ring with grouped KV: ppermute traffic stays at hkv heads
+    (expansion happens per hop, after the rotation)."""
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (2, 64, 8, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 64, 2, 16), jnp.float32)
+    v = jax.random.normal(ks[2], (2, 64, 2, 16), jnp.float32)
+    ref = dot_product_attention(q, k, v, causal=True)
+    attn = make_ring_attention(seq_mesh, causal=True)
+    out = jax.jit(attn)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
