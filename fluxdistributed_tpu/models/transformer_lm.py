@@ -407,6 +407,10 @@ class TransformerLM(nn.Module):
     norm: str = "layernorm"  # layernorm | rmsnorm
     norm_eps: float = 1e-6  # 1e-5 for HF GPT-2 weight interop
     mlp: str = "gelu"  # gelu | swiglu (MoE blocks keep their expert MLP)
+    # learned-positions (use_rope=False) table length; REQUIRED for
+    # decode with use_rope=False (later calls see t=1, but the param
+    # shape is fixed at creation)
+    max_len: Optional[int] = None
     # rematerialize each block in the backward pass: activations for only
     # ~one block live at a time, trading ~1 extra forward of FLOPs for
     # O(depth)x less activation memory -> longer sequences / bigger
@@ -429,10 +433,32 @@ class TransformerLM(nn.Module):
         x = embed(tokens)
         if not self.use_rope:
             t = tokens.shape[-1]
+            # the table length must be call-shape-independent once the
+            # param exists (flax shape-checks reuse): max_len pins it for
+            # decode (where later calls see t=1); default = first-call t
             pos_tab = self.param(
-                "pos_embedding", nn.initializers.normal(0.02), (t, self.dim)
+                "pos_embedding", nn.initializers.normal(0.02),
+                (self.max_len or t, self.dim),
             )
-            x = x + jnp.asarray(pos_tab, self.dtype)[None]
+            if self.decode:
+                # KV-cache decoding sees t=1 (or a prompt chunk): take the
+                # rows at the CURRENT global positions, tracked by a
+                # cursor in the cache — x + pos_tab[None] would silently
+                # broadcast the whole table over the short chunk
+                pos_index = self.variable(
+                    "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+                )
+                if not self.is_initializing():
+                    rows = jax.lax.dynamic_slice(
+                        jnp.asarray(pos_tab), (pos_index.value, 0),
+                        (t, self.dim),
+                    )
+                    pos_index.value = pos_index.value + t
+                    x = x + jnp.asarray(rows, self.dtype)[None]
+                else:
+                    x = x + jnp.asarray(pos_tab, self.dtype)[None, :t]
+            else:
+                x = x + jnp.asarray(pos_tab, self.dtype)[None, :t]
         if self.moe_every:
             # validate up front: a silently-dense "MoE" model (moe_every >
             # depth) or a late per-block error would mask misconfiguration
@@ -541,9 +567,11 @@ def generate(
 ):
     """Autoregressive sampling with the KV cache, as ONE compiled program.
 
-    ``model`` must be constructed with ``decode=True`` (and RoPE
-    positions — a learned positional table has no single-token lookup
-    path).  The prompt [B, P] int32 is PREFILLED in one parallel
+    ``model`` must be constructed with ``decode=True``.  Learned
+    positions (``use_rope=False``, e.g. imported GPT-2) decode through
+    the cache's ``pos_index`` cursor and additionally need ``max_len``
+    set (and ``total_len <= max_len``).  The prompt [B, P] int32 is
+    PREFILLED in one parallel
     full-width forward (writing all P keys/values into the cache at
     once), then a ``lax.scan`` of single-token cache steps samples out
     to ``total_len``: greedy at ``temperature=0``, else softmax
@@ -557,8 +585,17 @@ def generate(
     if not model.decode:
         raise ValueError("generate() needs a model built with decode=True")
     if not model.use_rope:
-        raise ValueError("generate() requires use_rope=True (a learned "
-                         "positional table has no per-token decode path)")
+        # learned positions decode via the pos_index cursor — but the
+        # table is finite, and dynamic_slice would silently CLAMP past
+        # its end (wrong positions, no error); bound it here, host-side
+        if model.max_len is None:
+            raise ValueError(
+                "generate() with use_rope=False needs max_len set on the "
+                "model (the learned positional table's length)")
+        if total_len > model.max_len:
+            raise ValueError(
+                f"total_len ({total_len}) exceeds the learned positional "
+                f"table (max_len={model.max_len})")
     prompt = jnp.asarray(prompt, jnp.int32)
     bsz, plen = prompt.shape
     if not (0 < plen <= total_len):

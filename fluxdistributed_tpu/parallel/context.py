@@ -229,17 +229,14 @@ def ulysses_attention(
         jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
         tiled=True,
     )
-    if k.shape[2] != q.shape[2] and k.shape[2] % axis_size == 0:
-        # grouped KV rides the all_to_all at hkv heads (the GQA comm
-        # saving) and is expanded only AFTER the re-shard
-        qg, kg, vg = gather(q), gather(k), gather(v)
-        kg, vg = _expand_kv(qg, kg, vg)
-    else:
-        # MHA, or hkv not divisible by the axis (the tiled head re-shard
-        # needs equal chunks per rank): expand first — correct, just
-        # without the grouped-comm saving
+    if k.shape[2] != q.shape[2] and k.shape[2] % axis_size != 0:
+        # hkv not divisible by the axis (the tiled head re-shard needs
+        # equal chunks per rank): expand BEFORE the gather — correct,
+        # just without the grouped-comm saving.  Divisible grouped KV
+        # rides the all_to_all at hkv heads; the local attention core
+        # broadcasts it itself.
         k, v = _expand_kv(q, k, v)
-        qg, kg, vg = gather(q), gather(k), gather(v)
+    qg, kg, vg = gather(q), gather(k), gather(v)
     out = dot_product_attention(qg, kg, vg, causal=causal)
     # [B, T, H/P, D] → [B, T/P, H, D]
     return jax.lax.all_to_all(

@@ -232,3 +232,91 @@ def test_gpt2_import_rejects_non_gpt2():
 
     with pytest.raises(ValueError, match="wte"):
         import_gpt2({"foo": 1}, num_heads=2)
+
+
+def test_gpt2_import_decode_matches_full_forward():
+    """Imported GPT-2 weights must also DECODE correctly: learned
+    positional rows are sliced at the cache cursor (a naive broadcast
+    would silently add the whole table to each single-token step)."""
+    transformers = pytest.importorskip("transformers")
+
+    import jax
+
+    from fluxdistributed_tpu.models import import_gpt2
+    from fluxdistributed_tpu.models.transformer_lm import TransformerLM
+
+    torch.manual_seed(1)
+    cfg = transformers.GPT2Config(
+        vocab_size=64, n_positions=16, n_embd=32, n_layer=2, n_head=2,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    hm = transformers.GPT2LMHeadModel(cfg).eval()
+    params, _ = import_gpt2(hm.state_dict(), num_heads=2, seqlen=16)
+
+    kw = dict(vocab=64, depth=2, dim=32, num_heads=2, mlp_dim=128,
+              dtype=jnp.float32, dropout=0.0, use_rope=False, norm_eps=1e-5,
+              max_len=16)
+    m = TransformerLM(**kw)
+    dm = TransformerLM(**kw, decode=True)
+    toks = np.random.default_rng(2).integers(0, 64, (2, 16)).astype(np.int32)
+    full = m.apply({"params": params}, jnp.asarray(toks), train=False)
+
+    # prefill 5 + single-token steps, through the positional cursor
+    cache = dm.init(jax.random.PRNGKey(0), jnp.zeros_like(toks), train=False)["cache"]
+    pre, mut = dm.apply(
+        {"params": params, "cache": cache}, jnp.asarray(toks[:, :5]),
+        train=False, mutable=["cache"],
+    )
+    cache = mut["cache"]
+    got = [np.asarray(pre)]
+    for t in range(5, toks.shape[1]):
+        logits, mut = dm.apply(
+            {"params": params, "cache": cache}, jnp.asarray(toks[:, t : t + 1]),
+            train=False, mutable=["cache"],
+        )
+        cache = mut["cache"]
+        got.append(np.asarray(logits))
+    np.testing.assert_allclose(
+        np.asarray(full), np.concatenate(got, axis=1), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_gpt2_generate_and_bounds():
+    """generate() works with imported-GPT-2-style models (use_rope=False
+    + max_len) and rejects sampling past the positional table."""
+    transformers = pytest.importorskip("transformers")
+
+    import jax
+
+    from fluxdistributed_tpu.models import generate, import_gpt2
+    from fluxdistributed_tpu.models.transformer_lm import TransformerLM
+
+    torch.manual_seed(2)
+    cfg = transformers.GPT2Config(
+        vocab_size=64, n_positions=16, n_embd=32, n_layer=2, n_head=2,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    hm = transformers.GPT2LMHeadModel(cfg).eval()
+    params, _ = import_gpt2(hm.state_dict(), num_heads=2, seqlen=16)
+    kw = dict(vocab=64, depth=2, dim=32, num_heads=2, mlp_dim=128,
+              dtype=jnp.float32, dropout=0.0, use_rope=False, norm_eps=1e-5,
+              max_len=16)
+    dm = TransformerLM(**kw, decode=True)
+
+    prompt = np.asarray([[3, 1, 4]], np.int32)
+    out = generate(dm, params, jnp.asarray(prompt), total_len=10,
+                   temperature=0.0)
+    assert out.shape == (1, 10)
+    # greedy generate must equal HF greedy continuation
+    with torch.no_grad():
+        href = hm.generate(
+            torch.from_numpy(prompt.astype(np.int64)), max_length=10,
+            do_sample=False, pad_token_id=0,
+        ).numpy()
+    np.testing.assert_array_equal(np.asarray(out), href)
+
+    with pytest.raises(ValueError, match="positional table"):
+        generate(dm, params, jnp.asarray(prompt), total_len=32)
+    dm_nolen = TransformerLM(**{**kw, "max_len": None}, decode=True)
+    with pytest.raises(ValueError, match="max_len"):
+        generate(dm_nolen, params, jnp.asarray(prompt), total_len=10)
