@@ -34,6 +34,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint", default=None,
                    help="checkpoint dir from the trainer (latest step used; "
                         "random init if omitted)")
+    p.add_argument("--gpt2-weights", default=None,
+                   help="a torch-saved HF GPT2LMHeadModel state_dict (.pt): "
+                        "the model config is inferred from the weights and "
+                        "--model/--vocab/--norm/--mlp are ignored")
+    p.add_argument("--gpt2-heads", type=int, default=None,
+                   help="GPT-2 head count (default: dim // 64, the GPT-2 "
+                        "family convention)")
     p.add_argument("--step", type=int, default=None, help="specific checkpoint step")
     p.add_argument("--prompt", default=None,
                    help="text prompt, encoded as UTF-8 bytes (needs vocab>=256)")
@@ -78,6 +85,14 @@ def main(argv=None) -> int:
 
     if args.prompt is not None and args.prompt_tokens is not None:
         raise SystemExit("pass --prompt OR --prompt-tokens, not both")
+    if args.gpt2_weights:
+        # GPT-2 vocab/limits come from the weights; validate against
+        # THOSE (not the --vocab default) and reject byte prompts (a
+        # BPE model has no byte-level mapping and no tokenizer here)
+        if args.prompt is not None:
+            raise SystemExit("--gpt2-weights has no tokenizer; pass "
+                             "--prompt-tokens (BPE ids)")
+        return _gpt2_main(args)
     if args.prompt is not None:
         if args.vocab < 256:
             raise SystemExit("--prompt is byte-encoded; needs --vocab >= 256")
@@ -118,14 +133,70 @@ def main(argv=None) -> int:
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         rng=jax.random.PRNGKey(args.seed) if args.temperature > 0 else None,
     )
-    toks = np.asarray(out)[0]
+    _emit(args, np.asarray(out)[0])
+    return 0
+
+
+def _gpt2_main(args) -> int:
+    """HF GPT-2 interop: architecture inferred from the weights
+    (``models.torch_import.gpt2_config`` owns the key-layout knowledge)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import torch
+
+    from fluxdistributed_tpu import models
+    from fluxdistributed_tpu.models import import_gpt2
+    from fluxdistributed_tpu.models.torch_import import gpt2_config
+    from fluxdistributed_tpu.models.transformer_lm import TransformerLM
+
+    sd = torch.load(args.gpt2_weights, map_location="cpu", weights_only=True)
+    try:
+        cfg = gpt2_config(sd)
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    heads = args.gpt2_heads or max(cfg["dim"] // 64, 1)
+    if args.length > cfg["n_positions"]:
+        raise SystemExit(f"--length {args.length} exceeds the GPT-2 "
+                         f"positional table ({cfg['n_positions']})")
+    args.vocab = cfg["vocab"]
+    if args.prompt_tokens is not None:
+        prompt = np.asarray([int(t) for t in args.prompt_tokens.split(",")],
+                            np.int32)
+        if prompt.min() < 0 or prompt.max() >= args.vocab:
+            raise SystemExit(f"prompt tokens must be in [0, {args.vocab})")
+    else:
+        prompt = np.zeros(1, np.int32)
+    if not (0 < len(prompt) < args.length):
+        raise SystemExit(
+            f"prompt length {len(prompt)} must be in (0, --length "
+            f"{args.length})")
+
+    params, _ = import_gpt2(sd, num_heads=heads, seqlen=args.length)
+    dm = TransformerLM(
+        vocab=cfg["vocab"], depth=cfg["depth"], dim=cfg["dim"],
+        num_heads=heads, mlp_dim=cfg["mlp_dim"], dtype=jnp.float32,
+        dropout=0.0, use_rope=False, norm_eps=1e-5, max_len=args.length,
+        decode=True,
+    )
+    print(f"loaded GPT-2 weights: depth={cfg['depth']} d={cfg['dim']} "
+          f"heads={heads} vocab={cfg['vocab']}", file=sys.stderr)
+    out = models.generate(
+        dm, params, prompt[None], total_len=args.length,
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+        rng=jax.random.PRNGKey(args.seed) if args.temperature > 0 else None,
+    )
+    _emit(args, np.asarray(out)[0])
+    return 0
+
+
+def _emit(args, toks) -> None:
     if args.vocab == 256:
         from fluxdistributed_tpu.data import ByteTextDataset
 
         print(ByteTextDataset.decode(toks))
     else:
         print(",".join(str(int(t)) for t in toks))
-    return 0
 
 
 if __name__ == "__main__":

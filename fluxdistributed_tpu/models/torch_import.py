@@ -188,6 +188,30 @@ def import_torch_vit(
     return params, {}
 
 
+def gpt2_config(state_dict: Mapping[str, Any]) -> dict:
+    """Infer a GPT-2 checkpoint's architecture from its weights alone:
+    ``{vocab, dim, depth, mlp_dim, n_positions}`` (head count is NOT in
+    the state_dict — the GPT-2 family convention is ``dim // 64``).
+    Single source of the key-layout knowledge shared with
+    :func:`import_gpt2` and ``bin/generate.py --gpt2-weights``."""
+    pre = "transformer." if "transformer.wte.weight" in state_dict else ""
+    if f"{pre}wte.weight" not in state_dict:
+        raise ValueError("not a GPT-2 state_dict (no wte.weight)")
+    vocab, d = _np(state_dict[f"{pre}wte.weight"]).shape
+    depth = 0
+    while f"{pre}h.{depth}.ln_1.weight" in state_dict:
+        depth += 1
+    if depth == 0:
+        raise ValueError("no transformer blocks found — not a GPT-2 state_dict")
+    return {
+        "vocab": int(vocab),
+        "dim": int(d),
+        "depth": depth,
+        "mlp_dim": int(_np(state_dict[f"{pre}h.0.mlp.c_fc.weight"]).shape[1]),
+        "n_positions": int(_np(state_dict[f"{pre}wpe.weight"]).shape[0]),
+    }
+
+
 def import_gpt2(
     state_dict: Mapping[str, Any], num_heads: int, seqlen: Optional[int] = None
 ) -> tuple[dict, dict]:

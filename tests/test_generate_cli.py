@@ -58,3 +58,42 @@ def test_arg_validation():
         gen_cli.main(["--vocab", "16", "--prompt-tokens", "99", "--length", "4"])
     with pytest.raises(SystemExit, match="must be in"):
         gen_cli.main(["--vocab", "16", "--prompt-tokens", "1,2", "--length", "2"])
+
+
+def test_generate_cli_gpt2_weights(tmp_path):
+    """bin/generate.py --gpt2-weights samples from a torch-saved HF
+    GPT-2 state_dict, config inferred from the weights, output equal to
+    HF's own greedy generate."""
+    import os
+    import subprocess
+
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    torch.manual_seed(3)
+    cfg = transformers.GPT2Config(
+        vocab_size=64, n_positions=16, n_embd=32, n_layer=2, n_head=2,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    hm = transformers.GPT2LMHeadModel(cfg).eval()
+    pt = tmp_path / "gpt2.pt"
+    torch.save(hm.state_dict(), pt)
+    with torch.no_grad():
+        ref = hm.generate(
+            torch.tensor([[3, 1, 4]]), max_length=10, do_sample=False,
+            pad_token_id=0,
+        )[0]
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join("bin", "generate.py"),
+         "--gpt2-weights", str(pt), "--gpt2-heads", "2",
+         "--prompt-tokens", "3,1,4", "--length", "10", "--platform", "cpu"],
+        capture_output=True, text=True, timeout=300, cwd=repo, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    got = out.stdout.strip().splitlines()[-1]
+    assert got == ",".join(str(int(t)) for t in ref), (got, ref)
