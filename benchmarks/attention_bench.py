@@ -86,15 +86,23 @@ def main():
             for _ in range(3)
         )
         fwd_flops = 4 * B * H * t * t * D / 2  # causal halves the score work
+        if args.window is not None:
+            # the windowed kernel's USEFUL work is the band, not T^2/2:
+            # sum_q min(q+1, W) attended keys (otherwise its TFLOP/s
+            # column would overstate by ~T/W and could exceed chip peak)
+            w = min(args.window, t)
+            attended = w * (w + 1) // 2 + max(t - w, 0) * w
+            fwd_flops_windowed = 4 * B * H * D * attended
         for name, fn in cores:
             if name == "dense" and t > 8192:
                 continue  # T^2 scores in HBM; keep the sweep bounded
             dt = timeit(fn, q, k, v, n=args.iters)
             dtg = timeit(grads[name], q, k, v, n=max(5, args.iters // 2))
+            fl = fwd_flops_windowed if name.startswith("pallas-flash-w") else fwd_flops
             rows.append({
                 "core": name, "T": t,
                 "fwd_ms": round(dt * 1e3, 3),
-                "fwd_tflops": round(fwd_flops / dt / 1e12, 2),
+                "fwd_tflops": round(fl / dt / 1e12, 2),
                 "fwdbwd_ms": round(dtg * 1e3, 3),
             })
             print(json.dumps(rows[-1]), flush=True)

@@ -64,12 +64,16 @@ fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqle
 fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 2048 --attn blockwise >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
 fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 4096 --batch 8 --attn flash >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
 fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 4096 --batch 8 --attn blockwise >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
+# round-5 attention features on hardware: windowed flash (O(T*W) block
+# skipping) and GQA (grouped KV, kv-heads=3 divides lm_small's 12 heads)
+fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 4096 --batch 8 --attn flash --window 1024 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
+fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 2048 --attn flash --kv-heads 3 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
 
 echo "[$(stamp)] 6/7 end-to-end ingest" | tee -a "$OUT/session.log"
 fits 3600 && timeout 3600 python benchmarks/ingest_e2e.py --steps 20 >> "$OUT/ingest.jsonl" 2>> "$OUT/session.log"
 fits 3600 && timeout 3600 python benchmarks/ingest_e2e.py --steps 20 --s2d >> "$OUT/ingest.jsonl" 2>> "$OUT/session.log"
 
-echo "[$(stamp)] 7/7 attention-core microbench" | tee -a "$OUT/session.log"
-fits 2700 && timeout 2700 python benchmarks/attention_bench.py >> "$OUT/attention.jsonl" 2>> "$OUT/session.log"
+echo "[$(stamp)] 7/7 attention-core microbench (incl. windowed-flash row)" | tee -a "$OUT/session.log"
+fits 2700 && timeout 2700 python benchmarks/attention_bench.py --window 1024 >> "$OUT/attention.jsonl" 2>> "$OUT/session.log"
 
 echo "[$(stamp)] session complete (incl. attention)" | tee -a "$OUT/session.log"
