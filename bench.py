@@ -88,6 +88,7 @@ def build_step(
     remat: bool = False,
     fuse: int = 1,
     s2d: bool = False,
+    zero1: bool = False,
 ):
     """Build the headline measurement target: ResNet-50, DP mesh over all
     chips, compiled train step, device-resident batch.
@@ -126,10 +127,22 @@ def build_step(
 
     loss_fn = flax_loss_fn(model, fd.logitcrossentropy)
     opt = optim.momentum(0.1, 0.9)
-    step = make_train_step(loss_fn, opt, mesh, donate=donate, accum_steps=accum_steps)
-    state = TrainState.create(
-        sharding.replicate(params, mesh), opt, model_state=sharding.replicate(mstate, mesh)
-    )
+    if zero1:
+        # ZeRO-1 weight-update sharding: same step math, optimizer state
+        # + update compute sharded 1/N over the data axis
+        from fluxdistributed_tpu.parallel import zero1 as zero1_lib
+
+        state, z_sh = zero1_lib.zero1_state(
+            params, opt, mesh, model_state=sharding.replicate(mstate, mesh)
+        )
+        step = zero1_lib.make_train_step_zero1(
+            loss_fn, opt, mesh, z_sh, donate=donate, accum_steps=accum_steps
+        )
+    else:
+        step = make_train_step(loss_fn, opt, mesh, donate=donate, accum_steps=accum_steps)
+        state = TrainState.create(
+            sharding.replicate(params, mesh), opt, model_state=sharding.replicate(mstate, mesh)
+        )
     # feed bf16 by default: the model casts to bf16 at its input anyway,
     # so an f32 feed only adds a 2x-wider HBM read + an in-graph convert
     xb = x if input_f32 else x.astype(jnp.bfloat16)

@@ -49,6 +49,12 @@ CONFIGS = [
     {"name": "s2d-lhs-fuse-8", "env": {
         "SWEEP_S2D": "1", "SWEEP_FUSE": "8",
         "SWEEP_XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=true"}},
+    # ZeRO-1 weight-update sharding: optimizer state + update 1/N over
+    # the data axis (reduce-scatter grads, all-gather params).  The
+    # momentum update is cheap vs ResNet-50 FLOPs, so this measures the
+    # reduce-scatter+all-gather vs all-reduce trade at DP numerics
+    {"name": "zero1", "env": {"SWEEP_ZERO1": "1"}},
+    {"name": "zero1-512", "env": {"SWEEP_ZERO1": "1", "SWEEP_BATCH": "512"}},
     {"name": "batch-512", "env": {"SWEEP_BATCH": "512"}},
     {"name": "lhs-batch-512", "env": {
         "SWEEP_BATCH": "512",
@@ -92,6 +98,7 @@ def measure_one() -> dict:
         remat=_env_flag("SWEEP_REMAT"),
         fuse=fuse,
         s2d=_env_flag("SWEEP_S2D"),
+        zero1=_env_flag("SWEEP_ZERO1"),
     )
     dt, _ = bench.time_compiled_step(
         step, state, b, target_seconds=float(os.environ.get("SWEEP_SECONDS", "2.0"))

@@ -71,8 +71,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="after training, aggregate loss/top-k over the FULL "
                         "--val-dataset with train.evaluate")
     p.add_argument("--spmd", default="jit",
-                   choices=["jit", "shard_map", "fsdp", "tp", "fsdp_tp",
+                   choices=["jit", "dp", "shard_map", "fsdp", "tp", "fsdp_tp",
                             "pp", "pp_1f1b", "ep", "sp"])
+    p.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1 weight-update sharding for the DP paths "
+                        "(--spmd jit/dp/shard_map): reduce-scatter grads, "
+                        "shard the optimizer state and update 1/N over the "
+                        "data axis, all-gather updated params — DP-identical "
+                        "numerics, ~N x lower optimizer memory")
     p.add_argument("--steps-per-call", type=int, default=1,
                    help="optimizer steps per dispatch (device loop; spmd=jit). "
                         "Amortizes host dispatch when the runtime is tunneled")
@@ -376,6 +382,9 @@ def main(argv=None) -> int:
             "--expert-parallel/--experts/--moe-every only apply with --spmd ep")
     if args.seq_parallel is not None and args.spmd != "sp":
         raise SystemExit("--seq-parallel only applies with --spmd sp")
+    if args.zero1 and args.spmd not in ("jit", "dp", "shard_map"):
+        raise SystemExit("--zero1 only applies with --spmd jit/dp/shard_map "
+                         "(fsdp already shards the optimizer state)")
     if args.sp_strategy != "ring" and args.spmd != "sp":
         raise SystemExit("--sp-strategy only applies with --spmd sp")
     if args.spmd in ("tp", "fsdp_tp"):
@@ -411,6 +420,7 @@ def main(argv=None) -> int:
         cycles=args.cycles,
         val_dataset=val_dataset,
         spmd=args.spmd,
+        zero1=args.zero1,
         steps_per_call=args.steps_per_call,
         **lm_extra,
     )

@@ -103,9 +103,11 @@ def main(argv=None) -> int:
             raise SystemExit(f"prompt tokens must be in [0, {args.vocab})")
     else:
         prompt = np.zeros(1, np.int32)
-    if not (0 < len(prompt) < args.length):
+    # <= : a prompt of exactly --length is valid per the generate()
+    # contract (nothing to sample — it returns the prompt unchanged)
+    if not (0 < len(prompt) <= args.length):
         raise SystemExit(
-            f"prompt length {len(prompt)} must be in (0, --length {args.length})"
+            f"prompt length {len(prompt)} must be in (0, --length {args.length}]"
         )
 
     model_fn = getattr(models, args.model)
@@ -167,10 +169,10 @@ def _gpt2_main(args) -> int:
             raise SystemExit(f"prompt tokens must be in [0, {args.vocab})")
     else:
         prompt = np.zeros(1, np.int32)
-    if not (0 < len(prompt) < args.length):
+    if not (0 < len(prompt) <= args.length):
         raise SystemExit(
             f"prompt length {len(prompt)} must be in (0, --length "
-            f"{args.length})")
+            f"{args.length}]")
 
     params, _ = import_gpt2(sd, num_heads=heads, seqlen=args.length)
     dm = TransformerLM(

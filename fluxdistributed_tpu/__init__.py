@@ -13,6 +13,7 @@ The package targets full parity with the reference's exported surface
 below are the currently implemented subset.
 """
 
+from . import compat  # noqa: F401 — must precede any jax-surface use
 from . import data, mesh, models, ops, optim, parallel, sharding, tree
 
 

@@ -1,0 +1,114 @@
+"""Toolchain compatibility shims.
+
+The framework is written against the current JAX surface
+(``jax.shard_map`` with the ``check_vma`` keyword, PEP 680 ``tomllib``).
+Older toolchains — e.g. a Python 3.10 / jax 0.4.x image — carry the same
+functionality under earlier names (``jax.experimental.shard_map`` with
+``check_rep``, the ``tomli`` backport).  Importing this module (the
+package ``__init__`` does, before anything touches jax) installs
+forwarders so the rest of the codebase is written ONCE against the
+modern names:
+
+* ``jax.shard_map`` — forwarded to ``jax.experimental.shard_map`` when
+  absent, translating ``check_vma=`` to the old ``check_rep=`` spelling.
+* ``tomllib`` — aliased to ``tomli`` in ``sys.modules`` when the stdlib
+  module is missing (Python < 3.11), so plain ``import tomllib`` works.
+
+No-ops on a modern toolchain.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+
+# True when this process runs the pre-VMA shard_map (jax <= 0.4.x).  The
+# legacy tracer does NOT insert the psum that the modern varying-manual-
+# axes transpose adds when differentiating w.r.t. a replicated input
+# inside shard_map — code relying on that implicit gradient reduction
+# (dp.make_train_step_shardmap) must branch on this flag and reduce
+# explicitly.
+LEGACY_SHARD_MAP = not hasattr(jax, "shard_map")
+
+
+def _install_shard_map() -> None:
+    if hasattr(jax, "shard_map"):
+        return
+    import inspect
+
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    legacy_params = inspect.signature(_legacy).parameters
+
+    def shard_map(f=None, /, **kwargs):
+        if f is None:  # used as @partial(jax.shard_map, mesh=..., ...)
+            # keep kwargs untranslated in the curried form: translation
+            # must run exactly once, at the final call, or an explicit
+            # check_vma=True would be clobbered by the re-entry default
+            import functools
+
+            return functools.partial(shard_map, **kwargs)
+        if "check_vma" not in legacy_params:
+            # the legacy replication checker predates the modern varying-
+            # manual-axes inference and rejects valid programs (e.g. the
+            # psum implicit in differentiating w.r.t. replicated params),
+            # so it is only enabled on explicit request
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            else:
+                kwargs.setdefault("check_rep", False)
+        return _legacy(f, **kwargs)
+
+    jax.shard_map = shard_map
+
+
+def _install_tree_paths() -> None:
+    """jax.tree.{leaves,flatten,map}_with_path appeared after 0.4.37;
+    forward them to the long-stable jax.tree_util spellings."""
+    import jax.tree
+    import jax.tree_util as tu
+
+    for name, impl in (
+        ("leaves_with_path", tu.tree_leaves_with_path),
+        ("flatten_with_path", tu.tree_flatten_with_path),
+        ("map_with_path", tu.tree_map_with_path),
+    ):
+        if not hasattr(jax.tree, name):
+            setattr(jax.tree, name, impl)
+
+
+def _install_vma_stubs() -> None:
+    """``jax.typeof`` / ``jax.lax.pcast`` are the VMA-era typing surface
+    (pipeline code uses them to mark values varying before ppermute).
+    The legacy tracer has no replication typing — every value is
+    effectively varying — so a no-op pcast and an aval-returning typeof
+    (whose missing ``.vma`` attribute makes callers' ``getattr(...,
+    frozenset())`` guards take the convert path harmlessly) are exactly
+    faithful."""
+    import jax.core
+    from jax import lax
+
+    if not hasattr(jax, "typeof"):
+        jax.typeof = jax.core.get_aval
+    if not hasattr(lax, "pcast"):
+        lax.pcast = lambda x, axis_name, *, to: x
+
+
+def _install_tomllib() -> None:
+    if "tomllib" in sys.modules:
+        return
+    try:
+        import tomllib  # noqa: F401 — stdlib (3.11+): nothing to do
+    except ModuleNotFoundError:
+        try:
+            import tomli
+        except ModuleNotFoundError:
+            return  # registry.load_registry will raise its own ImportError
+        sys.modules["tomllib"] = tomli
+
+
+_install_shard_map()
+_install_tree_paths()
+_install_vma_stubs()
+_install_tomllib()

@@ -125,6 +125,22 @@ class CausalSelfAttention(nn.Module):
             )
         b, t, d = x.shape
         assert d % self.num_heads == 0, "embed dim must divide num_heads"
+        # validate window/sinks ONCE, up front: without this the training
+        # forward rejects sinks-without-window deep inside
+        # dot_product_attention while the decode-cache path silently
+        # ignores sinks — the same misconfiguration must fail identically
+        # and early on both paths
+        if self.sinks < 0:
+            raise ValueError(f"sinks must be >= 0, got {self.sinks}")
+        if self.sinks and self.window is None:
+            raise ValueError(
+                f"sinks={self.sinks} requires a sliding window: attention "
+                "sinks pin the first keys OUTSIDE the window (StreamingLLM); "
+                "without window= every key is attendable and sinks have no "
+                "meaning. Pass window=<int> or sinks=0."
+            )
+        if self.window is not None and self.window < 1:
+            raise ValueError(f"window must be >= 1, got {self.window}")
         head_dim = d // self.num_heads
         hkv = self.num_kv_heads or self.num_heads
         if self.num_heads % hkv:
@@ -608,6 +624,10 @@ def generate(
     if (top_k or top_p < 1.0) and temperature == 0.0:
         raise ValueError("top_k/top_p filter a sampling distribution — "
                          "set temperature > 0 (greedy ignores them)")
+    if total_len == plen:
+        # score-only: nothing to sample, so skip the prefill forward
+        # entirely (its cache and first-token draw would be discarded)
+        return prompt
     # cache shapes from an abstract init trace of the FULL length — no
     # forward pass, no throwaway parameter materialization
     spec = jax.eval_shape(
@@ -666,8 +686,6 @@ def generate(
     cache = mut["cache"]
     key, sub = jax.random.split(key)
     first = sample(logits_p[:, -1], sub)
-    if total_len == plen:
-        return prompt
 
     def step(carry, _):
         cache, tok, key = carry

@@ -9,6 +9,14 @@ from .context import (
 from .dp import TrainState, make_train_step, make_eval_step, make_train_step_shardmap
 from . import fsdp
 from .fsdp import fsdp_specs, hybrid_fsdp_tp_specs, make_train_step_fsdp, make_eval_step_fsdp
+from . import zero1
+from .zero1 import (
+    make_train_step_zero1,
+    make_train_step_zero1_shardmap,
+    zero1_optimizer,
+    zero1_state,
+    zero1_state_shardings,
+)
 from .ep import (
     moe_apply,
     router_dispatch,
@@ -35,6 +43,12 @@ __all__ = [
     "hybrid_fsdp_tp_specs",
     "make_train_step_fsdp",
     "make_eval_step_fsdp",
+    "zero1",
+    "make_train_step_zero1",
+    "make_train_step_zero1_shardmap",
+    "zero1_optimizer",
+    "zero1_state",
+    "zero1_state_shardings",
     "ring_attention",
     "make_ring_attention",
     "ulysses_attention",

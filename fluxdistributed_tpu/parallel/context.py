@@ -221,9 +221,16 @@ def ulysses_attention(
     from ..ops.attention import dot_product_attention
 
     axis_size = jax.lax.psum(1, axis_name)
-    assert q.shape[2] % axis_size == 0, (
-        f"'{axis_name}' axis size {axis_size} must divide num_heads {q.shape[2]}"
-    )
+    if q.shape[2] % axis_size != 0:
+        # a real error, not an assert: without it the tiled all_to_all
+        # head re-shard fails later with an obscure reshape mismatch
+        raise ValueError(
+            f"ulysses_attention needs num_heads ({q.shape[2]}) divisible by "
+            f"the '{axis_name}' axis size ({axis_size}): the all_to_all "
+            "re-shards heads across the axis in equal chunks. Use a seq "
+            "axis that divides the head count, or ring attention "
+            "(make_ring_attention), which has no head-count constraint."
+        )
     # [B, T/P, H, D] → [B, T, H/P, D]
     gather = partial(
         jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,

@@ -325,8 +325,15 @@ def make_train_step_shardmap(
         # psums the cotangent across the mesh axis (the transpose of
         # replication); the reference's mean semantics
         # (sync_buffer's divide-by-N, src/ddp_tasks.jl:103-106) is then
-        # a division by the shard count, not another collective.
-        grads = tree_lib.div(grads, nshards)
+        # a division by the shard count, not another collective.  A
+        # pre-VMA shard_map tracer inserts NO such psum, so there the
+        # mean is one explicit collective instead.
+        from ..compat import LEGACY_SHARD_MAP
+
+        if LEGACY_SHARD_MAP:
+            grads = collectives.pmean(grads, axis)
+        else:
+            grads = tree_lib.div(grads, nshards)
         loss = jax.lax.pmean(loss, axis)
         # Mutable model state (BatchNorm running stats) is per-shard →
         # average it across replicas so replicas stay identical.

@@ -82,9 +82,29 @@ def initialize(
     if platform is not None:
         jax.config.update("jax_platforms", platform)
     if local_devices is not None:
-        jax.config.update("jax_num_cpu_devices", int(local_devices))
+        try:
+            jax.config.update("jax_num_cpu_devices", int(local_devices))
+        except AttributeError:
+            # pre-0.5 jax: the option doesn't exist — the XLA flag is the
+            # same knob read at backend init (we run before that).  A
+            # pre-set count must be REWRITTEN, not kept: the caller's
+            # request wins over e.g. a CI harness's stale pin.
+            import re as _re
+
+            flag = f"--xla_force_host_platform_device_count={int(local_devices)}"
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" in flags:
+                flags = _re.sub(
+                    r"--xla_force_host_platform_device_count=\d+", flag, flags
+                )
+            else:
+                flags = (flags + " " + flag).strip()
+            os.environ["XLA_FLAGS"] = flags
     plat = platform or os.environ.get("JAX_PLATFORMS", "")
-    if "cpu" in plat:
+    if "cpu" in plat and (num_processes is not None or coordinator_address):
+        # cross-process CPU collectives only; a single process needs no
+        # transport (and pre-0.5 jaxlib rejects gloo without a
+        # distributed client)
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     if num_processes is None and coordinator_address is None:
         # single-process / auto-detected TPU environment

@@ -133,7 +133,8 @@ def load_checkpoint(
     semantics).  With ``mesh`` given, restored arrays are placed on the
     mesh ready to hand back to a compiled train step: each leaf takes its
     ``target`` leaf's sharding when the target is device-placed (so an
-    FSDP-sharded state restores sharded, not gathered), else replicated.
+    FSDP-sharded state — or a ZeRO-1 state's flat data-sharded optimizer
+    leaves — restores sharded, not gathered), else replicated.
     Restore is topology-independent either way — the placement comes from
     the *restoring* target/mesh, never from the saved run's devices.
     """
@@ -148,7 +149,11 @@ def load_checkpoint(
         # restoring blind: a blind restore re-applies the SAVED device
         # shardings, which fails when the saving topology (e.g. 8 CPU
         # devices) differs from the restoring one (e.g. 1 TPU).
-        meta = ckptr.metadata(path).item_metadata.tree
+        meta = ckptr.metadata(path)
+        # newer orbax wraps the metadata pytree (CompositeCheckpointMetadata
+        # .item_metadata.tree); older releases return the tree itself
+        item = getattr(meta, "item_metadata", None)
+        meta = item.tree if item is not None and hasattr(item, "tree") else meta
         target = jax.tree.map(
             lambda m: np.zeros(m.shape, m.dtype) if hasattr(m, "shape") else m,
             meta,

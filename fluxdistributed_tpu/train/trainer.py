@@ -115,6 +115,7 @@ def prepare_training(
     seed: int = 0,
     input_shape: Optional[Sequence[int]] = None,
     spmd: str = "jit",
+    zero1: bool = False,
     donate: bool = False,
     topk: Sequence[int] = (1, 5, 10),
     accum_steps: int = 1,
@@ -131,10 +132,19 @@ def prepare_training(
 
     ``val_samples`` defaults to the reference's 300-sample val slice
     (src/ddp_tasks.jl:145).  ``spmd`` selects the compiled path: ``"jit"``
-    (auto-sharded DP), ``"shard_map"`` (explicit collectives), or
-    ``"fsdp"`` (ZeRO-3: params + optimizer state sharded across the data
-    axis, see ``parallel/fsdp.py`` — same step math, ~N× lower state
-    memory on an N-way mesh).
+    (auto-sharded DP; ``"dp"`` is an alias), ``"shard_map"`` (explicit
+    collectives), or ``"fsdp"`` (ZeRO-3: params + optimizer state sharded
+    across the data axis, see ``parallel/fsdp.py`` — same step math, ~N×
+    lower state memory on an N-way mesh).
+
+    ``zero1=True`` upgrades the DP paths (``"jit"``/``"dp"``/
+    ``"shard_map"``) to ZeRO-1 weight-update sharding
+    (``parallel/zero1.py``): gradients reduce-scatter, the optimizer
+    state and update compute shard 1/N over the data axis, updated
+    params all-gather — DP-identical numerics at ~N× lower optimizer
+    memory.  Composes with ``accum_steps``, ``steps_per_call``,
+    ``donate`` and OOM-skip; checkpoints carry the sharded optimizer
+    state (orbax restores shard-to-shard).
 
     ``donate=True`` donates the TrainState buffers to each step (halves
     peak state memory — worthwhile for very large models) but is
@@ -166,10 +176,18 @@ def prepare_training(
     """
     from ..data.loader import apply_transform
 
+    if spmd == "dp":  # explicit-name alias for the auto-sharded DP path
+        spmd = "jit"
     if steps_per_call < 1:
         raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
     if steps_per_call != 1 and spmd != "jit":
         raise ValueError("steps_per_call > 1 requires spmd='jit'")
+    if zero1 and spmd not in ("jit", "shard_map"):
+        raise ValueError(
+            "zero1=True applies to the DP paths only (spmd='jit'/'dp'/"
+            f"'shard_map'); got spmd={spmd!r} — fsdp already shards the "
+            "optimizer state (ZeRO-3 subsumes ZeRO-1)"
+        )
     if num_microbatches is not None and spmd not in ("pp", "pp_1f1b"):
         raise ValueError("num_microbatches requires spmd='pp' or 'pp_1f1b'")
     if pipeline_interleave and spmd != "pp_1f1b":
@@ -429,8 +447,8 @@ def prepare_training(
     else:
         if spmd not in ("jit", "shard_map", "sp"):
             raise ValueError(
-                f"unknown spmd mode {spmd!r}; pick one of jit / shard_map / "
-                "fsdp / tp / fsdp_tp / pp / pp_1f1b / ep / sp"
+                f"unknown spmd mode {spmd!r}; pick one of jit (alias dp) / "
+                "shard_map / fsdp / tp / fsdp_tp / pp / pp_1f1b / ep / sp"
             )
         if spmd == "sp":
             # sequence/context parallelism rides the plain jit path with
@@ -446,25 +464,47 @@ def prepare_training(
                         "built with attn_fn=make_ring_attention(mesh, "
                         "batch_axis='data', ...)"
                     )
-        if spmd == "shard_map":
-            if accum_steps != 1:
-                raise ValueError("accum_steps > 1 requires spmd='jit'")
-            from ..parallel.dp import make_train_step_shardmap as maker
+        if spmd == "shard_map" and accum_steps != 1:
+            raise ValueError("accum_steps > 1 requires spmd='jit'")
+        if zero1:
+            # ZeRO-1: DP step math, optimizer state + update sharded 1/N
+            # over the data axis (parallel/zero1.py)
+            from ..parallel import zero1 as zero1_lib
 
-            step_fn = maker(loss_fn, optimizer, mesh, donate=donate, seed=seed)
-        else:
-            step_fn = make_train_step(
-                loss_fn, optimizer, mesh,
-                donate=donate, accum_steps=accum_steps, seed=seed,
-                steps_per_call=steps_per_call,
+            state, z_sh = zero1_lib.zero1_state(
+                params, optimizer, mesh, model_state=model_state
             )
-        eval_fn = make_eval_step(loss_fn, mesh, topk=tuple(topk))
+            if spmd == "shard_map":
+                step_fn = zero1_lib.make_train_step_zero1_shardmap(
+                    loss_fn, optimizer, mesh, state, donate=donate, seed=seed
+                )
+            else:
+                step_fn = zero1_lib.make_train_step_zero1(
+                    loss_fn, optimizer, mesh, z_sh,
+                    donate=donate, accum_steps=accum_steps, seed=seed,
+                    steps_per_call=steps_per_call,
+                )
+            eval_fn = make_eval_step(
+                loss_fn, mesh, topk=tuple(topk), state_shardings=z_sh
+            )
+        else:
+            if spmd == "shard_map":
+                from ..parallel.dp import make_train_step_shardmap as maker
 
-        state = TrainState.create(
-            sharding_lib.replicate(params, mesh),
-            optimizer,
-            model_state=sharding_lib.replicate(model_state, mesh),
-        )
+                step_fn = maker(loss_fn, optimizer, mesh, donate=donate, seed=seed)
+            else:
+                step_fn = make_train_step(
+                    loss_fn, optimizer, mesh,
+                    donate=donate, accum_steps=accum_steps, seed=seed,
+                    steps_per_call=steps_per_call,
+                )
+            eval_fn = make_eval_step(loss_fn, mesh, topk=tuple(topk))
+
+            state = TrainState.create(
+                sharding_lib.replicate(params, mesh),
+                optimizer,
+                model_state=sharding_lib.replicate(model_state, mesh),
+            )
 
     loader = PrefetchLoader(
         dataset,

@@ -57,9 +57,23 @@ def test_arg_validation():
     with pytest.raises(SystemExit, match="in \\[0, 16\\)"):
         gen_cli.main(["--vocab", "16", "--prompt-tokens", "99", "--length", "4"])
     with pytest.raises(SystemExit, match="must be in"):
-        gen_cli.main(["--vocab", "16", "--prompt-tokens", "1,2", "--length", "2"])
+        gen_cli.main(["--vocab", "16", "--prompt-tokens", "1,2,3", "--length", "2"])
 
 
+def test_full_length_prompt_is_score_only(capsys):
+    """A prompt of exactly --length is accepted (the generate() contract:
+    nothing to sample, the prompt comes back unchanged) — not rejected
+    by an off-by-one CLI guard."""
+    rc = gen_cli.main([
+        "--model", "lm_tiny", "--vocab", "16",
+        "--prompt-tokens", "3,1,4", "--length", "3",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out.strip()
+    assert [int(t) for t in out.split(",")] == [3, 1, 4]
+
+
+@pytest.mark.slow  # torch+transformers import plus a JAX CLI subprocess
 def test_generate_cli_gpt2_weights(tmp_path):
     """bin/generate.py --gpt2-weights samples from a torch-saved HF
     GPT-2 state_dict, config inferred from the weights, output equal to

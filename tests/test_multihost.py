@@ -16,6 +16,11 @@ import sys
 
 import pytest
 
+# Every test here spawns real OS processes (multi-minute wall-clock);
+# module-level mark so additions inherit it and the tier-1
+# ``-m 'not slow'`` lane stays fast — full CI still runs them.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
