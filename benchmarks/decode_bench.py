@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Decode throughput: continuous batching vs sequential ``generate()``.
+
+The serving-side companion of lm_bench.py (training tokens/sec).  Two
+questions, per concurrency level:
+
+1. **prefill vs steady-state decode tokens/sec** — prompt ingestion is
+   matmul-dense and parallel over positions; decode is one token per
+   step and memory-bound.  The ratio is the reason slot-based
+   continuous batching exists.
+2. **continuous batching vs sequential** — aggregate NEW tokens/sec for
+   C concurrent requests through the slot engine (one fixed-shape
+   compiled step serves all live requests) vs the same C requests run
+   one-at-a-time through ``models.generate``.  Two sequential baselines
+   are recorded: the AS-SHIPPED path (a fresh ``generate()`` call per
+   request, which re-traces its scan every call — what ``bin/
+   generate.py`` serving actually cost before this engine), and an
+   idealized CACHED program (the whole sampler under one ``jax.jit``,
+   reused across requests — the strongest sequential opponent).  The
+   headline ``speedup_vs_sequential`` is against the as-shipped path;
+   ``speedup_vs_sequential_cached`` tells the honest steady-state story
+   (on a compute-bound CPU it hovers near the batch-GEMM amortization
+   limit; the TPU session rows measure the memory-bound regime where
+   slot batching actually pays).
+
+Each row also records the engine's compile counts: steady-state decode
+must hold at ONE compiled step program after warmup — a recompile in
+the serving loop is a bug (arXiv:1810.09868's fixed-shape lesson).
+
+    python benchmarks/decode_bench.py --platform cpu     # CPU rows (CI)
+    python benchmarks/decode_bench.py --model lm_small --vocab 32000 \
+        --prompt-len 128 --new-tokens 256                # TPU session row
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="lm_tiny",
+                    choices=["lm_tiny", "lm_small", "lm_medium"])
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--prompt-len", type=int, default=32)
+    ap.add_argument("--new-tokens", type=int, default=64)
+    ap.add_argument("--concurrency", default="1,4,16",
+                    help="comma-separated request counts")
+    ap.add_argument("--max-slots", type=int, default=16,
+                    help="engine slot count (capped at each row's C)")
+    ap.add_argument("--kv-heads", type=int, default=None)
+    ap.add_argument("--window", type=int, default=None)
+    ap.add_argument("--sinks", type=int, default=0)
+    ap.add_argument("--dtype", default="auto", choices=["auto", "bf16", "f32"],
+                    help="model compute dtype: auto = bf16 on TPU (native "
+                         "MXU format), f32 elsewhere (CPU emulates bf16 "
+                         "matmuls ~8x slower — both serving paths use the "
+                         "same model, so the comparison stays fair)")
+    ap.add_argument("--platform", default=None, help="force platform (e.g. cpu)")
+    args = ap.parse_args()
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import numpy as np
+
+    from fluxdistributed_tpu import models
+    from fluxdistributed_tpu.serve import LMEngine, Request, Scheduler
+
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    plen, new = args.prompt_len, args.new_tokens
+    total = plen + new
+    if args.dtype == "auto":
+        dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
+    else:
+        dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    model = getattr(models, args.model)(
+        vocab=args.vocab, num_kv_heads=args.kv_heads, window=args.window,
+        sinks=args.sinks, dtype=dtype)
+    rng = np.random.default_rng(0)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 2), np.int32), train=False
+    )["params"]
+    dm = model.clone(decode=True)
+
+    def prompts(c):
+        return [rng.integers(0, args.vocab, plen).astype(np.int32) for _ in range(c)]
+
+    # idealized sequential baseline: the whole sampler under ONE jit so
+    # repeated requests reuse a compiled program
+    seq_fn = jax.jit(
+        lambda p: models.generate(dm, params, p, total_len=total))
+
+    def run_sequential_cached(ps):
+        t0 = time.perf_counter()
+        for p in ps:
+            np.asarray(seq_fn(p[None]))
+        return time.perf_counter() - t0
+
+    def run_sequential_shipped(p):
+        # the pre-engine serving path: one bare generate() per request,
+        # re-tracing its scan every call.  Identical independent calls,
+        # so one timed call IS the per-request cost (scaled to C below).
+        t0 = time.perf_counter()
+        np.asarray(models.generate(dm, params, p[None], total_len=total))
+        return time.perf_counter() - t0
+
+    for c in [int(x) for x in args.concurrency.split(",")]:
+        slots = max(1, min(args.max_slots, c))
+        engine = LMEngine(model, params, max_slots=slots, max_len=total,
+                          buckets=(plen,))
+        # warmup: compile prefill/insert/decode once (also warms the
+        # sequential program via one throwaway generate call)
+        warm = Scheduler(engine)
+        warm.generate_all([Request(prompt=list(range(2)), max_new_tokens=2)])
+        np.asarray(seq_fn(prompts(1)[0][None]))
+        compiles_before = engine.compile_stats()
+
+        ps = prompts(c)
+        seq_cached_sec = run_sequential_cached(ps)
+        seq_shipped_sec = run_sequential_shipped(ps[0]) * c
+
+        sched = Scheduler(engine, max_queue=max(c, 1))
+        reqs = [Request(prompt=list(p), max_new_tokens=new) for p in ps]
+        t0 = time.perf_counter()
+        sched.generate_all(reqs)
+        eng_sec = time.perf_counter() - t0
+        m = sched.metrics()
+        compiles_after = engine.compile_stats()
+
+        seq_tps = c * new / seq_shipped_sec
+        seq_cached_tps = c * new / seq_cached_sec
+        eng_tps = c * new / eng_sec
+        no_recompile = (
+            compiles_after["decode_compiles"] == compiles_before["decode_compiles"] == 1
+        )
+        print(json.dumps({
+            "metric": f"{args.model} continuous-batching decode throughput "
+                      f"({platform}, {jnp.dtype(dtype).name}, C={c}, "
+                      f"slots={slots}, P={plen}, N={new}, "
+                      f"vocab {args.vocab})",
+            "value": round(eng_tps, 2),
+            "unit": "new tokens/sec aggregate",
+            "concurrency": c,
+            "sequential_tokens_per_sec": round(seq_tps, 2),
+            "speedup_vs_sequential": round(eng_tps / seq_tps, 2),
+            "sequential_cached_tokens_per_sec": round(seq_cached_tps, 2),
+            "speedup_vs_sequential_cached": round(eng_tps / seq_cached_tps, 2),
+            "prefill_tokens_per_sec": round(m["prefill_tokens_per_sec"], 2),
+            "steady_decode_tokens_per_sec": round(
+                m["decode_tokens_per_sec"], 2),
+            "ttft_ms_avg": round(m["ttft_sec_avg"] * 1e3, 2),
+            "decode_compiles": compiles_after["decode_compiles"],
+            "prefill_compiles": compiles_after["prefill_compiles"],
+            "no_recompile_after_warmup": bool(no_recompile),
+        }))
+        if not no_recompile:
+            print(f"WARNING: decode step recompiled mid-serve "
+                  f"(compiles {compiles_before} -> {compiles_after})",
+                  file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -73,7 +73,14 @@ echo "[$(stamp)] 6/7 end-to-end ingest" | tee -a "$OUT/session.log"
 fits 3600 && timeout 3600 python benchmarks/ingest_e2e.py --steps 20 >> "$OUT/ingest.jsonl" 2>> "$OUT/session.log"
 fits 3600 && timeout 3600 python benchmarks/ingest_e2e.py --steps 20 --s2d >> "$OUT/ingest.jsonl" 2>> "$OUT/session.log"
 
-echo "[$(stamp)] 7/7 attention-core microbench (incl. windowed-flash row)" | tee -a "$OUT/session.log"
+echo "[$(stamp)] 7/8 attention-core microbench (incl. windowed-flash row)" | tee -a "$OUT/session.log"
 fits 2700 && timeout 2700 python benchmarks/attention_bench.py --window 1024 >> "$OUT/attention.jsonl" 2>> "$OUT/session.log"
 
-echo "[$(stamp)] session complete (incl. attention)" | tee -a "$OUT/session.log"
+# serving decode: continuous batching vs sequential generate at
+# C={1,4,16} (CPU rows recorded in docs/benchmarks.md; these are the
+# first TPU rows — lm_small realistic-vocab, then the windowed config)
+echo "[$(stamp)] 8/8 decode / serving bench" | tee -a "$OUT/session.log"
+fits 2700 && timeout 2700 python benchmarks/decode_bench.py --model lm_small --vocab 32000 --prompt-len 128 --new-tokens 256 >> "$OUT/decode.jsonl" 2>> "$OUT/session.log"
+fits 2700 && timeout 2700 python benchmarks/decode_bench.py --model lm_small --vocab 32000 --prompt-len 128 --new-tokens 256 --window 1024 --sinks 4 >> "$OUT/decode.jsonl" 2>> "$OUT/session.log"
+
+echo "[$(stamp)] session complete (incl. decode)" | tee -a "$OUT/session.log"

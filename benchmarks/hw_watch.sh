@@ -118,12 +118,28 @@ while :; do
         echo "[$(stamp)] watch: stop file present; exiting"
         exit 0
     fi
-    if [ "$(date +%s)" -ge "$(( DEADLINE - 2400 ))" ]; then
+    # 2520 = probe bound (120) + full attempt bound (2400): the bench
+    # launch can trail the loop-top check by a whole probe
+    if [ "$(date +%s)" -ge "$(( DEADLINE - 2520 ))" ]; then
         echo "[$(stamp)] watch: attempt would straddle the deadline; exiting to free the slot"
         exit 0
     fi
     attempt=$((attempt + 1))
-    echo "[$(stamp)] watch: bench attempt $attempt"
+    # cheap bounded pre-probe: a ~2-min jax.devices() ping answers "is
+    # the chip granting AT ALL?" before committing a 2400 s bench bound.
+    # Short grant windows used to be missed because a dead-chip attempt
+    # sat in TPU init for its full 2400 s timeout (one probe-able window
+    # per ~40 min); with the gate, dead attempts cost ~2 min and the
+    # watcher re-probes ~13x more often.  The full attempt launches only
+    # on probe success (and must still fit the deadline on its own).
+    echo "[$(stamp)] watch: probe attempt $attempt (120s jax.devices ping)"
+    if ! timeout -k 10 120 python -c 'import jax; print(jax.devices())' \
+            >> "$OUT/watch.err" 2>&1; then
+        echo "[$(stamp)] watch: probe $attempt found no granting chip; retrying in 120s"
+        sleep 120
+        continue
+    fi
+    echo "[$(stamp)] watch: probe $attempt SUCCESS; launching full bench attempt"
     timeout 2400 python bench.py --one > "$OUT/.try.json" 2>> "$OUT/watch.err"
     rc=$?
     if [ "$rc" = 0 ] && grep -q '"value"' "$OUT/.try.json" 2>/dev/null; then

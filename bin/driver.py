@@ -436,7 +436,18 @@ def main(argv=None) -> int:
     if args.wandb:
         from fluxdistributed_tpu.train.logging import WandbLogger
 
-        logger = WandbLogger(project="fluxdistributed_tpu")
+        # push the full run configuration at init (reference
+        # src/loggers/wandb.jl:1 passes config= to WandbLogger): every
+        # arch/spmd/optimizer flag plus the resolved runtime facts —
+        # runs become comparable by WHAT they trained, not just curves
+        run_config = dict(sorted(vars(args).items()))
+        run_config.update(
+            devices=jax.device_count(),
+            hosts=jax.process_count(),
+            platform=jax.devices()[0].platform,
+            mesh={k: int(v) for k, v in dict(mesh.shape).items()},
+        )
+        logger = WandbLogger(project="fluxdistributed_tpu", config=run_config)
     else:
         # per-host logs like the reference's per-worker @info records;
         # non-coordinators stay quiet unless --verbose

@@ -33,11 +33,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="vocab size (256 = byte-level, text: corpora)")
     p.add_argument("--checkpoint", default=None,
                    help="checkpoint dir from the trainer (latest step used; "
-                        "random init if omitted)")
+                        "random init if omitted). May be an http(s):// or "
+                        "gs:// URL — a remote .zip of the checkpoint dir is "
+                        "fetched and unpacked through the dataset source "
+                        "cache (data/sources.py)")
     p.add_argument("--gpt2-weights", default=None,
                    help="a torch-saved HF GPT2LMHeadModel state_dict (.pt): "
                         "the model config is inferred from the weights and "
-                        "--model/--vocab/--norm/--mlp are ignored")
+                        "--model/--vocab/--norm/--mlp are ignored. May be "
+                        "an http(s):// or gs:// URL (fetched + cached)")
+    p.add_argument("--engine", action="store_true",
+                   help="decode through the continuous-batching engine "
+                        "(fluxdistributed_tpu.serve) instead of the "
+                        "lax.scan sampler — same greedy output token for "
+                        "token; temperature sampling uses the engine's "
+                        "per-request key stream")
     p.add_argument("--gpt2-heads", type=int, default=None,
                    help="GPT-2 head count (default: dim // 64, the GPT-2 "
                         "family convention)")
@@ -117,8 +127,10 @@ def main(argv=None) -> int:
     train_model = model_fn(vocab=args.vocab, **arch)
 
     if args.checkpoint:
+        from fluxdistributed_tpu.data.sources import fetch_checkpoint
         from fluxdistributed_tpu.train import load_checkpoint
 
+        args.checkpoint = fetch_checkpoint(args.checkpoint)
         restored = load_checkpoint(args.checkpoint, step=args.step)
         params = restored["params"]
         print(f"loaded checkpoint step "
@@ -130,13 +142,40 @@ def main(argv=None) -> int:
         )["params"]
         print("no --checkpoint: sampling from a RANDOM-INIT model", file=sys.stderr)
 
-    out = models.generate(
-        dm, params, prompt[None], total_len=args.length,
-        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
-        rng=jax.random.PRNGKey(args.seed) if args.temperature > 0 else None,
-    )
-    _emit(args, np.asarray(out)[0])
+    if args.engine:
+        out = _engine_generate(args, train_model, params, prompt)
+    else:
+        out = np.asarray(models.generate(
+            dm, params, prompt[None], total_len=args.length,
+            temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+            rng=jax.random.PRNGKey(args.seed) if args.temperature > 0 else None,
+        ))[0]
+    _emit(args, out)
     return 0
+
+
+def _engine_generate(args, train_model, params, prompt):
+    """One prompt through the serving engine's decode core — the CLI and
+    the server share one compiled-step implementation."""
+    import numpy as np
+
+    from fluxdistributed_tpu.serve import LMEngine, Request, Scheduler
+
+    if args.top_k or args.top_p < 1.0:
+        raise SystemExit("--engine does not support --top-k/--top-p "
+                         "(drop them or use the lax.scan sampler)")
+    if args.length == len(prompt):
+        return np.asarray(prompt)  # score-only: the generate() contract
+    # bucket at the PROMPT length (the ladder tops up to --length
+    # itself): prefill then runs over plen positions, not a --length-
+    # padded buffer — same work as the lax.scan path's prefill
+    engine = LMEngine(train_model, params, max_slots=1, max_len=args.length,
+                      buckets=(len(prompt),))
+    sched = Scheduler(engine)
+    req = Request(prompt=list(prompt),
+                  max_new_tokens=args.length - len(prompt),
+                  temperature=args.temperature, seed=args.seed)
+    return np.asarray(sched.generate_all([req])[0], np.int32)
 
 
 def _gpt2_main(args) -> int:
@@ -152,7 +191,10 @@ def _gpt2_main(args) -> int:
     from fluxdistributed_tpu.models.torch_import import gpt2_config
     from fluxdistributed_tpu.models.transformer_lm import TransformerLM
 
-    sd = torch.load(args.gpt2_weights, map_location="cpu", weights_only=True)
+    from fluxdistributed_tpu.data.sources import fetch_artifact
+
+    sd = torch.load(fetch_artifact(args.gpt2_weights), map_location="cpu",
+                    weights_only=True)
     try:
         cfg = gpt2_config(sd)
     except ValueError as e:
@@ -175,20 +217,23 @@ def _gpt2_main(args) -> int:
             f"{args.length}]")
 
     params, _ = import_gpt2(sd, num_heads=heads, seqlen=args.length)
-    dm = TransformerLM(
+    tm = TransformerLM(
         vocab=cfg["vocab"], depth=cfg["depth"], dim=cfg["dim"],
         num_heads=heads, mlp_dim=cfg["mlp_dim"], dtype=jnp.float32,
         dropout=0.0, use_rope=False, norm_eps=1e-5, max_len=args.length,
-        decode=True,
     )
     print(f"loaded GPT-2 weights: depth={cfg['depth']} d={cfg['dim']} "
           f"heads={heads} vocab={cfg['vocab']}", file=sys.stderr)
-    out = models.generate(
-        dm, params, prompt[None], total_len=args.length,
-        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
-        rng=jax.random.PRNGKey(args.seed) if args.temperature > 0 else None,
-    )
-    _emit(args, np.asarray(out)[0])
+    if args.engine:
+        out = _engine_generate(args, tm, params, prompt)
+    else:
+        out = np.asarray(models.generate(
+            tm.clone(decode=True), params, prompt[None],
+            total_len=args.length,
+            temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+            rng=jax.random.PRNGKey(args.seed) if args.temperature > 0 else None,
+        ))[0]
+    _emit(args, out)
     return 0
 
 

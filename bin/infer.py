@@ -31,14 +31,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-classes", type=int, default=1000)
     p.add_argument("--checkpoint", default=None,
                    help="checkpoint dir from the trainer (latest step used; "
-                        "random init if omitted)")
+                        "random init if omitted). http(s)://-or-gs:// URLs "
+                        "are fetched through the dataset source cache "
+                        "(a remote .zip of the dir is unpacked) — the "
+                        "reference notebook's trained-model download, "
+                        "bin/pluto.jl:52-124")
     p.add_argument("--step", type=int, default=None, help="specific checkpoint step")
     p.add_argument("--torch-weights", default=None,
                    help=".pt/.pth file with a torchvision-layout ResNet "
                         "state_dict (the pretrained-weight path; analog of "
-                        "the reference's getweights, src/preprocess.jl:9-24)")
+                        "the reference's getweights, src/preprocess.jl:9-24)."
+                        " May be an http(s):// or gs:// URL (fetched+cached)")
     p.add_argument("--synset", default=None,
-                   help="LOC_synset_mapping.txt for human-readable labels")
+                   help="LOC_synset_mapping.txt for human-readable labels "
+                        "(local path or http(s)://-/gs://-fetched)")
     p.add_argument("--topk", type=int, default=3,
                    help="predictions per image (reference demo: top-3)")
     p.add_argument("--image-size", type=int, default=224)
@@ -68,11 +74,13 @@ def main(argv=None) -> int:
         return 2
     model = factory(num_classes=args.num_classes)
 
+    from fluxdistributed_tpu.data.sources import fetch_artifact, fetch_checkpoint
+
     names = None
     if args.synset:
         from fluxdistributed_tpu.data.imagenet import labels
 
-        table = labels(args.synset)
+        table = labels(fetch_artifact(args.synset))
         names = [n.split(",")[0] for n in table.names]
 
     if args.images:
@@ -95,7 +103,7 @@ def main(argv=None) -> int:
 
         try:
             model, variables = load_torch_weights_for(
-                args.model, args.num_classes, args.torch_weights
+                args.model, args.num_classes, fetch_artifact(args.torch_weights)
             )
         except ValueError as e:
             print(str(e), file=sys.stderr)
@@ -106,6 +114,7 @@ def main(argv=None) -> int:
 
         # raw (target-free) restore: works for checkpoints from ANY
         # optimizer — inference only needs params/model_state/step
+        args.checkpoint = fetch_checkpoint(args.checkpoint)
         restored = load_checkpoint(args.checkpoint, step=args.step)
         variables = {"params": restored["params"], **restored.get("model_state", {})}
         print(f"restored checkpoint step {int(restored['step'])} from {args.checkpoint}")

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Interactive inference demo — the webcam notebook, TPU-native.
+"""Inference server — webcam demo (vision) or LM serving (``--lm``).
 
 The reference's Pluto notebook embeds an HTML/JS webcam widget
 (bin/pluto.jl:133-334) and classifies captured frames with a trained
@@ -17,7 +17,18 @@ model (:338-382).  The analog here is a tiny stdlib HTTP server:
 
 Then open http://localhost:8000 in a browser.  Works with trainer
 checkpoints (``--checkpoint``), torchvision-layout weights
-(``--torch-weights``), or random init (demo mode).
+(``--torch-weights``), or random init (demo mode).  Remote weights
+(``http(s)://`` / ``gs://``) are fetched through the dataset source
+cache.
+
+With ``--lm`` the server instead fronts the continuous-batching LM
+engine (``fluxdistributed_tpu.serve``): ``POST /v1/generate`` with
+optional chunked streaming plus ``/healthz`` and ``/metrics``:
+
+    python bin/serve.py --lm --model lm_tiny --checkpoint ck/ \
+        --max-slots 8 --max-len 1024 --port 8000
+    curl -d '{"prompt": "The quick", "max_tokens": 64}' \
+        localhost:8000/v1/generate
 """
 
 from __future__ import annotations
@@ -71,14 +82,95 @@ def build_parser():
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--model", default="resnet50")
     p.add_argument("--num-classes", type=int, default=1000)
-    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--checkpoint", default=None,
+                   help="trainer checkpoint dir (http(s)://- or gs://-"
+                        "fetched; remote .zip dirs are unpacked)")
     p.add_argument("--torch-weights", default=None)
     p.add_argument("--synset", default=None)
     p.add_argument("--topk", type=int, default=3)
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--platform", default=None)
+    # --- LM serving mode (continuous-batching engine) ---
+    p.add_argument("--lm", action="store_true",
+                   help="serve a TransformerLM through the continuous-"
+                        "batching engine (POST /v1/generate) instead of "
+                        "the vision webcam demo")
+    p.add_argument("--vocab", type=int, default=256,
+                   help="LM vocab size (256 = byte-level text prompts)")
+    p.add_argument("--step", type=int, default=None,
+                   help="specific checkpoint step (LM mode)")
+    p.add_argument("--max-slots", type=int, default=8,
+                   help="concurrent decode slots (the fixed compiled "
+                        "batch of the decode step)")
+    p.add_argument("--max-len", type=int, default=1024,
+                   help="per-slot KV budget: prompt + generated tokens")
+    p.add_argument("--buckets", default="128,512,2048",
+                   help="comma-separated prefill shape buckets (prompts "
+                        "pad up to the smallest covering bucket)")
+    p.add_argument("--max-queue", type=int, default=64,
+                   help="admission queue bound; beyond it /v1/generate "
+                        "returns 429 (backpressure)")
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="match the trainer's --kv-heads (GQA)")
+    p.add_argument("--window", type=int, default=None,
+                   help="match the trainer's --window (ring KV cache)")
+    p.add_argument("--sinks", type=int, default=0,
+                   help="match the trainer's --sinks (attention sinks)")
+    p.add_argument("--norm", default="layernorm",
+                   choices=["layernorm", "rmsnorm"],
+                   help="match the trainer's --norm")
+    p.add_argument("--mlp", default="gelu", choices=["gelu", "swiglu"],
+                   help="match the trainer's --mlp")
     return p
+
+
+def make_lm_app(args):
+    """Build the LM-serving stack: ``(LMServer, Scheduler)``.
+
+    Separate from HTTP wiring so tests can drive the scheduler directly
+    (the ``make_app`` pattern below).
+    """
+    import jax
+    import numpy as np
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from fluxdistributed_tpu import models
+    from fluxdistributed_tpu.serve import LMEngine, LMServer, Scheduler
+
+    model_fn = getattr(models, args.model, None)
+    if model_fn is None or not args.model.startswith("lm_"):
+        raise SystemExit(f"--lm needs an lm_* model factory, got {args.model!r}")
+    model = model_fn(vocab=args.vocab, num_kv_heads=args.kv_heads,
+                     window=args.window, sinks=args.sinks, norm=args.norm,
+                     mlp=args.mlp)
+    if args.checkpoint:
+        from fluxdistributed_tpu.data.sources import fetch_checkpoint
+        from fluxdistributed_tpu.train import load_checkpoint
+
+        restored = load_checkpoint(fetch_checkpoint(args.checkpoint),
+                                   step=args.step)
+        params = restored["params"]
+        print(f"loaded checkpoint step "
+              f"{int(np.asarray(restored.get('step', -1)))} "
+              f"from {args.checkpoint}", file=sys.stderr)
+    else:
+        params = model.init(
+            jax.random.PRNGKey(0), np.zeros((1, 2), np.int32), train=False
+        )["params"]
+        print("no --checkpoint: serving a RANDOM-INIT model", file=sys.stderr)
+
+    try:
+        buckets = tuple(int(b) for b in args.buckets.split(","))
+    except ValueError:
+        raise SystemExit(f"--buckets must be comma-separated ints, got "
+                         f"{args.buckets!r}")
+    engine = LMEngine(model, params, max_slots=args.max_slots,
+                      max_len=args.max_len, buckets=buckets)
+    scheduler = Scheduler(engine, max_queue=args.max_queue)
+    return LMServer(scheduler, args.vocab), scheduler
 
 
 def make_app(args):
@@ -98,13 +190,15 @@ def make_app(args):
         raise SystemExit(f"unknown model {args.model!r}")
     if args.torch_weights and args.checkpoint:
         raise SystemExit("--torch-weights and --checkpoint are mutually exclusive")
+    from fluxdistributed_tpu.data.sources import fetch_artifact, fetch_checkpoint
+
     dummy = np.zeros((1, 224, 224, 3), np.float32)
     if args.torch_weights:
         from fluxdistributed_tpu.models.torch_import import load_torch_weights_for
 
         try:
             model, variables = load_torch_weights_for(
-                args.model, args.num_classes, args.torch_weights
+                args.model, args.num_classes, fetch_artifact(args.torch_weights)
             )
         except ValueError as e:
             raise SystemExit(str(e))
@@ -112,7 +206,7 @@ def make_app(args):
         model = factory(num_classes=args.num_classes)
         from fluxdistributed_tpu.train.checkpoint import load_checkpoint
 
-        restored = load_checkpoint(args.checkpoint)
+        restored = load_checkpoint(fetch_checkpoint(args.checkpoint))
         variables = {"params": restored["params"], **restored.get("model_state", {})}
     else:
         model = factory(num_classes=args.num_classes)
@@ -122,7 +216,7 @@ def make_app(args):
     if args.synset:
         from fluxdistributed_tpu.data.imagenet import labels
 
-        names = [n.split(",")[0] for n in labels(args.synset).names]
+        names = [n.split(",")[0] for n in labels(fetch_artifact(args.synset)).names]
 
     fwd = jax.jit(lambda v, x: model.apply(v, x, train=False))
     fwd(variables, dummy)  # compile before the first request
@@ -191,6 +285,18 @@ def serve(args, predict):
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.lm:
+        lm_server, _ = make_lm_app(args)
+        srv = lm_server.serve(args.host, args.port)
+        print(f"serving LM on http://{args.host}:{srv.server_address[1]}/"
+              f"v1/generate (ctrl-c to stop)")
+        try:
+            srv.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            lm_server.stop_loop()
+        return 0
     predict = make_app(args)
     srv = serve(args, predict)
     try:

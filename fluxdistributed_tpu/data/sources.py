@@ -27,7 +27,10 @@ import urllib.error
 import urllib.parse
 import urllib.request
 
-__all__ = ["FileSource", "HTTPSource", "GCSSource", "make_source"]
+__all__ = [
+    "FileSource", "HTTPSource", "GCSSource", "make_source",
+    "fetch_artifact", "fetch_checkpoint",
+]
 
 
 class FileSource:
@@ -171,3 +174,80 @@ def make_source(path_or_url: str, cache_dir: str | None = None):
     if scheme in ("http", "https"):
         return HTTPSource(path_or_url, cache_dir=cache_dir)
     return FileSource(path_or_url)
+
+
+def fetch_artifact(path_or_url: str, cache_dir: str | None = None) -> str:
+    """Resolve a single-file artifact to a LOCAL path, fetching if remote.
+
+    The inference CLIs' analog of the reference notebook's trained-model
+    download (bin/pluto.jl:52-124 fetches a BSON from JuliaHub job
+    results): ``--torch-weights``/``--gpt2-weights``/``--synset``/
+    ``--checkpoint`` may name an ``http(s)://`` or ``gs://`` object and
+    it is pulled through the SAME cached source machinery the dataset
+    registry uses (retry/backoff, atomic rename, OAuth for private
+    buckets).  Local paths pass through untouched.
+    """
+    url = str(path_or_url)
+    scheme = urllib.parse.urlparse(url).scheme
+    if scheme not in ("http", "https", "gs"):
+        return url
+    base, _, name = url.rstrip("/").rpartition("/")
+    if not name:
+        raise ValueError(f"cannot split a file name out of {url!r}")
+    return make_source(base, cache_dir=cache_dir).local_path(name)
+
+
+def fetch_checkpoint(path_or_url: str, cache_dir: str | None = None) -> str:
+    """Resolve a checkpoint location to a LOCAL directory or file.
+
+    Local paths pass through.  A remote ``.zip`` (the portable way to
+    ship an orbax checkpoint DIRECTORY over plain HTTP/GCS) is fetched
+    via :func:`fetch_artifact` and unpacked next to its cache entry —
+    once; later calls reuse the extracted tree.  Any other remote file
+    (e.g. a ``.pt``) is simply fetched.
+    """
+    url = str(path_or_url)
+    if urllib.parse.urlparse(url).scheme not in ("http", "https", "gs"):
+        return url
+    local = fetch_artifact(url, cache_dir=cache_dir)
+    if not local.endswith(".zip"):
+        return local
+    dest = local[: -len(".zip")] + ".extracted"
+    marker = os.path.join(dest, ".complete")
+    if not os.path.exists(marker):
+        import shutil
+        import zipfile
+
+        # concurrency-safe: each fetcher extracts into its OWN temp dir
+        # (a shared ".part" path would let one process rmtree another's
+        # in-progress extraction), then renames into place; the loser of
+        # the rename race discards its copy if the winner completed.
+        tmp = tempfile.mkdtemp(
+            dir=os.path.dirname(dest) or ".",
+            prefix=os.path.basename(dest) + ".",
+        )
+        with zipfile.ZipFile(local) as zf:
+            zf.extractall(tmp)
+        open(os.path.join(tmp, ".complete"), "w").close()
+        try:
+            os.replace(tmp, dest)
+        except OSError:
+            if os.path.exists(marker):
+                shutil.rmtree(tmp)  # another fetcher won; use theirs
+            else:
+                # dest is a dead partial from a crashed run: clear it
+                # and retry once
+                shutil.rmtree(dest, ignore_errors=True)
+                os.replace(tmp, dest)
+    # a zip that wraps everything in one top-level dir unwraps to it —
+    # unless that dir looks like a STEP dir ("step_0"/"0"), i.e. the
+    # zip holds a checkpoint ROOT with a single saved step, which must
+    # stay the root for latest_step() discovery
+    import re
+
+    entries = [e for e in os.listdir(dest) if e != ".complete"]
+    if (len(entries) == 1
+            and not re.fullmatch(r"(step[_-]?)?\d+", entries[0])
+            and os.path.isdir(os.path.join(dest, entries[0]))):
+        return os.path.join(dest, entries[0])
+    return dest

@@ -42,6 +42,7 @@ __all__ = [
     "next_token_loss",
     "rope",
     "generate",
+    "make_decode_cache",
     "lm_pp",
     "MoEDecoderBlock",
     "moe_expert_fn",
@@ -110,9 +111,32 @@ class CausalSelfAttention(nn.Module):
     num_kv_heads: Optional[int] = None  # GQA: None/num_heads → MHA
     window: Optional[int] = None  # sliding-window attention (causal)
     sinks: int = 0  # StreamingLLM attention sinks (first `sinks` keys)
+    # continuous-batching mode (serve/engine.py): each batch row is an
+    # independent request SLOT with its own cache cursor — cache_index
+    # becomes [B] and the windowed ring's slot_pos becomes [B, cache_len],
+    # so slots at different depths decode together in ONE fixed-shape
+    # compiled step.  Only single-token steps are supported post-init
+    # (prefill runs through the scalar-index path on a batch-1 model and
+    # the engine splices the result into the slot).
+    slot_decode: bool = False
+    # extra windowed-ring capacity beyond sinks+window.  The serving
+    # engine prefills prompts RIGHT-PADDED to a shape bucket; pad
+    # positions write into the ring, and with an exactly-sized ring a
+    # pad write can evict an IN-BAND real key (position p is evicted by
+    # position p+ring).  Slack >= the largest pad run makes pad
+    # eviction impossible (p+ring lands beyond every written position);
+    # the engine sets this to its largest INTER-BUCKET GAP (the worst
+    # pad run under smallest-covering-bucket assignment) and masks the
+    # pad entries themselves out at splice time.  Band semantics are
+    # untouched — a larger ring only RETAINS more, and retained
+    # out-of-band keys are mask-excluded anyway.
+    ring_slack: int = 0
 
     @nn.compact
     def __call__(self, x):
+        if self.slot_decode and not self.decode:
+            raise ValueError("slot_decode=True requires decode=True (it is "
+                             "a mode OF the KV-cache path)")
         if self.decode and self.attn_fn is not None:
             # the KV-cache path below always attends with the dense
             # core; silently dropping a mesh-sharded attn_fn (e.g. ring
@@ -178,7 +202,7 @@ class CausalSelfAttention(nn.Module):
             # wraparound.
             cache_len = (
                 t if self.window is None
-                else min(self.window + self.sinks, t)
+                else min(self.window + self.sinks + self.ring_slack, t)
             )
             cached_k = self.variable(
                 "cache", "cached_k", jnp.zeros,
@@ -188,15 +212,76 @@ class CausalSelfAttention(nn.Module):
                 "cache", "cached_v", jnp.zeros,
                 (b, cache_len, hkv, head_dim), v.dtype,
             )
+            # slot mode: one cursor (and one ring position table) PER
+            # batch row, so every slot advances independently
+            idx_shape = (b,) if self.slot_decode else ()
             cache_index = self.variable(
-                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+                "cache", "cache_index", lambda: jnp.zeros(idx_shape, jnp.int32)
             )
             slot_pos = None
             if self.window is not None:
+                sp_shape = (
+                    (b, cache_len) if self.slot_decode else (cache_len,)
+                )
                 slot_pos = self.variable(
                     "cache", "slot_pos",
-                    lambda: jnp.full((cache_len,), -1, jnp.int32),
+                    lambda: jnp.full(sp_shape, -1, jnp.int32),
                 )
+            if not is_init and self.slot_decode:
+                # ONE token per slot, every slot at its own depth.  The
+                # math mirrors the scalar-index path exactly (same write
+                # layout, same mask algebra) so a slot's token stream is
+                # bit-identical to a batch-1 sequential decode.
+                if t != 1:
+                    raise ValueError(
+                        f"slot_decode steps one token per slot (t=1), got "
+                        f"t={t}; prefill runs through a batch-1 scalar-index "
+                        "model and is spliced into the slot by the engine")
+                idx = cache_index.value  # [B] per-slot cursors
+                total = cached_k.value.shape[1]
+                if self.use_rope:
+                    pos = idx[:, None]  # [B, 1] global positions
+                    q, k = rope(q, pos), rope(k, pos)
+                rows = jnp.arange(b)
+                if self.window is None:
+                    # parked slots may have run past the cache end; their
+                    # writes drop harmlessly (output is discarded and the
+                    # engine resets the cursor on re-admission)
+                    cached_k.value = cached_k.value.at[rows, idx].set(
+                        k[:, 0], mode="drop")
+                    cached_v.value = cached_v.value.at[rows, idx].set(
+                        v[:, 0], mode="drop")
+                    allow = jnp.arange(total)[None, :] <= idx[:, None]
+                    attn_k, attn_v = cached_k.value, cached_v.value
+                else:
+                    # read [ring ∥ new token] BEFORE the rolling write —
+                    # the same order as the scalar path, so the key this
+                    # token evicts stays attendable for this very step
+                    attn_k = jnp.concatenate([cached_k.value, k], axis=1)
+                    attn_v = jnp.concatenate([cached_v.value, v], axis=1)
+                    sp = jnp.concatenate(
+                        [slot_pos.value, idx[:, None]], axis=1)  # [B, total+1]
+                    qg = idx[:, None]
+                    allow = (sp >= 0) & (sp <= qg)
+                    in_band = sp > qg - self.window
+                    if self.sinks:
+                        in_band |= sp < self.sinks
+                    allow &= in_band
+                    ring = max(total - self.sinks, 1)
+                    if self.sinks:
+                        ring_slot = self.sinks + (idx - self.sinks) % ring
+                        slot = jnp.where(idx < self.sinks, idx, ring_slot)
+                    else:
+                        slot = idx % ring
+                    cached_k.value = cached_k.value.at[rows, slot].set(k[:, 0])
+                    cached_v.value = cached_v.value.at[rows, slot].set(v[:, 0])
+                    slot_pos.value = slot_pos.value.at[rows, slot].set(idx)
+                cache_index.value = idx + 1
+                allow = allow[:, None, None, :]  # [B, 1, 1, keys]
+                out = dot_product_attention(q, attn_k, attn_v, mask=allow)
+                return nn.DenseGeneral(
+                    d, axis=(-2, -1), dtype=self.dtype, name="out"
+                )(out)
             if not is_init:
                 # t == 1: one sampling step.  t > 1: batched PREFILL — the
                 # whole prompt's K/V written in one parallel pass (one
@@ -296,6 +381,8 @@ class DecoderBlock(nn.Module):
     norm: str = "layernorm"
     mlp: str = "gelu"
     norm_eps: float = 1e-6
+    slot_decode: bool = False
+    ring_slack: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -306,7 +393,8 @@ class DecoderBlock(nn.Module):
             self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn,
             use_rope=self.use_rope, decode=self.decode,
             num_kv_heads=self.num_kv_heads, window=self.window,
-            sinks=self.sinks,
+            sinks=self.sinks, slot_decode=self.slot_decode,
+            ring_slack=self.ring_slack,
         )(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -360,6 +448,8 @@ class MoEDecoderBlock(nn.Module):
     sinks: int = 0
     norm: str = "layernorm"
     norm_eps: float = 1e-6
+    slot_decode: bool = False
+    ring_slack: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -368,7 +458,8 @@ class MoEDecoderBlock(nn.Module):
             self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn,
             use_rope=self.use_rope, decode=self.decode,
             num_kv_heads=self.num_kv_heads, window=self.window,
-            sinks=self.sinks,
+            sinks=self.sinks, slot_decode=self.slot_decode,
+            ring_slack=self.ring_slack,
         )(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -417,6 +508,13 @@ class TransformerLM(nn.Module):
     use_rope: bool = True
     tie_embeddings: bool = True
     decode: bool = False
+    # continuous-batching decode (serve/engine.py): per-slot cache
+    # cursors so independent requests at different depths share ONE
+    # compiled single-token step.  Requires decode=True.
+    slot_decode: bool = False
+    # extra windowed-ring KV capacity so bucket-padded prefill cannot
+    # evict in-band keys (see CausalSelfAttention.ring_slack)
+    ring_slack: int = 0
     num_kv_heads: Optional[int] = None  # GQA: grouped KV heads
     window: Optional[int] = None  # sliding-window attention
     sinks: int = 0  # StreamingLLM attention sinks (with window)
@@ -460,17 +558,35 @@ class TransformerLM(nn.Module):
                 # KV-cache decoding sees t=1 (or a prompt chunk): take the
                 # rows at the CURRENT global positions, tracked by a
                 # cursor in the cache — x + pos_tab[None] would silently
-                # broadcast the whole table over the short chunk
+                # broadcast the whole table over the short chunk.  Slot
+                # mode keeps one cursor per row (each slot is its own
+                # request at its own depth).
                 pos_index = self.variable(
-                    "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+                    "cache", "pos_index",
+                    lambda: jnp.zeros(
+                        (tokens.shape[0],) if self.slot_decode else (),
+                        jnp.int32),
                 )
                 if not self.is_initializing():
-                    rows = jax.lax.dynamic_slice(
-                        jnp.asarray(pos_tab), (pos_index.value, 0),
-                        (t, self.dim),
-                    )
-                    pos_index.value = pos_index.value + t
-                    x = x + jnp.asarray(rows, self.dtype)[None]
+                    if self.slot_decode:
+                        if t != 1:
+                            raise ValueError(
+                                "slot_decode with use_rope=False steps one "
+                                f"token per slot (t=1), got t={t}")
+                        # gather clamps parked slots past the table end —
+                        # their output is discarded by the engine anyway
+                        rows = jnp.take(
+                            jnp.asarray(pos_tab), pos_index.value, axis=0
+                        )[:, None, :]  # [B, 1, dim]
+                        pos_index.value = pos_index.value + 1
+                        x = x + jnp.asarray(rows, self.dtype)
+                    else:
+                        rows = jax.lax.dynamic_slice(
+                            jnp.asarray(pos_tab), (pos_index.value, 0),
+                            (t, self.dim),
+                        )
+                        pos_index.value = pos_index.value + t
+                        x = x + jnp.asarray(rows, self.dtype)[None]
                 else:
                     x = x + jnp.asarray(pos_tab, self.dtype)[None, :t]
             else:
@@ -507,6 +623,7 @@ class TransformerLM(nn.Module):
                     decode=self.decode, num_kv_heads=self.num_kv_heads,
                     window=self.window, sinks=self.sinks, norm=self.norm,
                     norm_eps=self.norm_eps, name=f"block{i}",
+                    slot_decode=self.slot_decode, ring_slack=self.ring_slack,
                 )(x, train)
             else:
                 x = block_cls(
@@ -516,6 +633,7 @@ class TransformerLM(nn.Module):
                     num_kv_heads=self.num_kv_heads, window=self.window,
                     sinks=self.sinks, norm=self.norm, mlp=self.mlp,
                     norm_eps=self.norm_eps, name=f"block{i}",
+                    slot_decode=self.slot_decode, ring_slack=self.ring_slack,
                 )(x, train)
         x = _norm_layer(self.norm, self.dtype, name="final_ln", eps=self.norm_eps)(x)
         if self.tie_embeddings:
@@ -569,6 +687,35 @@ def lm_loss_fn(model: TransformerLM) -> Callable:
         return loss, (model_state, logits)
 
     return fn
+
+
+def make_decode_cache(model: TransformerLM, batch: int, total_len: int):
+    """Fresh zero KV cache for a ``decode=True`` model, shaped for
+    ``batch`` rows out to ``total_len`` tokens.
+
+    Shapes come from an abstract init trace of the FULL length — no
+    forward pass, no throwaway parameter materialization.  Shared by
+    :func:`generate` (one cache per sampling call) and the continuous-
+    batching engine (``serve/engine.py`` — one slot cache plus a batch-1
+    prefill template).  Zero-fill is right for K/V and every cursor, but
+    the windowed ring's ``slot_pos`` initializer is -1 ("unwritten, never
+    attendable") — a zero there would masquerade as a written position-0
+    key.
+    """
+    spec = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros((batch, total_len), jnp.int32),
+            train=False,
+        )
+    )["cache"]
+
+    def _cache_leaf(path, s):
+        name = getattr(path[-1], "key", None)
+        if name == "slot_pos":
+            return jnp.full(s.shape, -1, s.dtype)
+        return jnp.zeros(s.shape, s.dtype)
+
+    return jax.tree_util.tree_map_with_path(_cache_leaf, spec)
 
 
 def generate(
@@ -628,24 +775,7 @@ def generate(
         # score-only: nothing to sample, so skip the prefill forward
         # entirely (its cache and first-token draw would be discarded)
         return prompt
-    # cache shapes from an abstract init trace of the FULL length — no
-    # forward pass, no throwaway parameter materialization
-    spec = jax.eval_shape(
-        lambda: model.init(
-            jax.random.PRNGKey(0), jnp.zeros((bsz, total_len), jnp.int32), train=False
-        )
-    )["cache"]
-
-    def _cache_leaf(path, s):
-        # zero-fill is right for K/V/index, but the windowed ring's
-        # slot_pos initializer is -1 ("unwritten, never attendable") —
-        # a zero there would masquerade as a written position-0 key
-        name = getattr(path[-1], "key", None)
-        if name == "slot_pos":
-            return jnp.full(s.shape, -1, s.dtype)
-        return jnp.zeros(s.shape, s.dtype)
-
-    cache = jax.tree_util.tree_map_with_path(_cache_leaf, spec)
+    cache = make_decode_cache(model, bsz, total_len)
     key = rng if rng is not None else jax.random.PRNGKey(0)
 
     vocab = model.vocab

@@ -1,0 +1,18 @@
+"""Continuous-batching LM inference (the serving half of the north
+star): slot-based KV cache engine, prefill/decode scheduler, and a
+streaming HTTP front end — all requests flow through two compiled XLA
+programs (bucketed prefill + fixed-slot decode)."""
+
+from .engine import DEFAULT_BUCKETS, LMEngine
+from .scheduler import QueueFull, Request, Scheduler
+from .server import LMServer, serve_lm
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "LMEngine",
+    "LMServer",
+    "QueueFull",
+    "Request",
+    "Scheduler",
+    "serve_lm",
+]

@@ -1,0 +1,306 @@
+"""Slot-based continuous-batching engine for ``TransformerLM``.
+
+The ROADMAP's inference half ("serve heavy traffic") needs many
+concurrent requests per chip, but per-request Python loops throw away
+exactly what makes TPUs fast: a small set of fixed-shape compiled XLA
+programs (arXiv:1810.09868's core lesson).  This engine serves ANY
+number of requests through exactly two jitted programs plus a splice:
+
+* **Bucketed prefill** — a batch-1 scalar-index decode forward over the
+  prompt padded up to a shape bucket ({128, 512, 2048} by default), so
+  the jit cache holds one compiled prefill per bucket and stays warm no
+  matter what prompt lengths arrive.  Right-padding is safe by
+  construction: a position's cache slot is a function of the position
+  alone, the causal mask admits only positions ≤ the query's, and every
+  pad entry is overwritten by the real token for its position before it
+  could ever become attendable.
+* **Fixed-slot decode** — ONE single-token step over all ``max_slots``
+  cache rows of a ``slot_decode=True`` model (per-slot cursors, see
+  models/transformer_lm.py), compiled once.  Finished requests free
+  their slot; admissions splice a prefilled batch-1 cache into a free
+  row mid-flight without touching the compiled step.
+
+The slot cache layout is the model's own: ``max_slots × (sinks + window
+| max_len)`` per layer, ring-buffer + pinned sinks when windowed.
+Greedy decoding is token-for-token identical to sequential
+:func:`models.generate` (the golden parity test,
+tests/test_serve_engine.py); temperature sampling uses an independent
+per-request key stream (``fold``-free: keys split inside the compiled
+step), so it is distribution-identical but not key-stream-identical.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer_lm import TransformerLM, make_decode_cache
+
+__all__ = ["LMEngine", "DEFAULT_BUCKETS"]
+
+DEFAULT_BUCKETS = (128, 512, 2048)
+
+
+def _jit_cache_size(fn) -> int:
+    """Compile count of a jitted callable (-1 if this jax can't say).
+    The decode bench asserts steady state holds at ONE decode compile."""
+    probe = getattr(fn, "_cache_size", None)
+    try:
+        return int(probe()) if callable(probe) else -1
+    except Exception:
+        return -1
+
+
+class LMEngine:
+    """Compiled-program pool + slot cache for continuous batching.
+
+    ``model`` is the TRAINING-mode ``TransformerLM`` (the engine derives
+    its own ``decode=True`` clones); ``params`` its trained parameters.
+    The engine is not thread-safe by itself — the scheduler serializes
+    all calls onto one loop thread.
+    """
+
+    def __init__(
+        self,
+        model: TransformerLM,
+        params,
+        *,
+        max_slots: int = 8,
+        max_len: int = 1024,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+    ):
+        if model.moe_every:
+            raise ValueError(
+                "the serving engine supports dense models only (MoE decode "
+                "routes per-token expert dispatch; build the model with "
+                "moe_every=0)")
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2, got {max_len}")
+        if not model.use_rope:
+            if model.max_len is None or model.max_len < max_len:
+                raise ValueError(
+                    f"use_rope=False needs the model's learned positional "
+                    f"table to cover the engine's max_len ({max_len}); got "
+                    f"model.max_len={model.max_len}")
+        # clamp buckets to the cache and always top out AT max_len:
+        # without the top bucket, a prompt in (largest bucket, max_len]
+        # would be rejected even though the slot cache can hold it
+        bl = sorted({int(b) for b in buckets if 0 < int(b) < max_len}
+                    | {max_len})
+        self.buckets: Tuple[int, ...] = tuple(bl)
+        self.max_slots = max_slots
+        self.max_len = max_len
+        # store weights in the model's COMPUTE dtype once, up front.
+        # flax casts f32-stored params to `dtype` inside every apply;
+        # generate()'s scan hoists that cast out of its loop, but the
+        # engine's per-token step would pay the full-tree cast EVERY
+        # step (it dominated the step on CPU).  Pre-casting is the same
+        # rounding, applied once — numerics identical, and the resident
+        # weight footprint halves for bf16 models.
+        self.params = jax.tree.map(
+            lambda x: jnp.asarray(
+                x, model.dtype if jnp.issubdtype(
+                    jnp.asarray(x).dtype, jnp.floating) else None),
+            params)
+        self.model = model
+        # decode=True rejects attn_fn by design (the cache path always
+        # uses the dense core — the math is identical for gathered
+        # weights); dropout is inference-irrelevant.  ring_slack sizes
+        # the windowed ring so BUCKET-PADDED prefill can never evict an
+        # in-band real key (pad writes land beyond every real position's
+        # reach); _insert then scrubs the pad entries themselves.  The
+        # slack needed is the largest possible PAD RUN: a prompt padded
+        # to its smallest covering bucket pads by less than the gap to
+        # the previous bucket — so dense buckets keep windowed slot
+        # caches near sinks+window instead of max_len.
+        if model.window is not None:
+            gaps = [self.buckets[0]] + [
+                b - a for a, b in zip(self.buckets, self.buckets[1:])]
+            slack = max(gaps)
+        else:
+            slack = 0
+        #: per-slot per-layer KV rows actually allocated.  For windowed
+        #: models this is sinks+window+slack (slack = largest bucket
+        #: gap), NOT sinks+window: sparse buckets inflate it.  Pass a
+        #: denser bucket ladder to tighten the bound toward the window.
+        self.kv_rows_per_slot = (
+            max_len if model.window is None
+            else min(model.window + model.sinks + slack, max_len))
+        self.decode_model = model.clone(
+            decode=True, slot_decode=True, attn_fn=None, dropout=0.0,
+            ring_slack=slack)
+        self.prefill_model = model.clone(
+            decode=True, slot_decode=False, attn_fn=None, dropout=0.0,
+            ring_slack=slack)
+        self.cache = make_decode_cache(self.decode_model, max_slots, max_len)
+        # reusable zero template: _prefill never mutates its input, so
+        # one template serves every admission
+        self._prefill_zero = make_decode_cache(self.prefill_model, 1, max_len)
+        # per-slot sampling state lives ON DEVICE between steps — the
+        # decode loop's only host traffic is the one token sync the
+        # scheduler needs for stop checks and streaming
+        self._tok = jnp.zeros((max_slots,), jnp.int32)
+        self._temp = jnp.zeros((max_slots,), jnp.float32)
+        self._keys = jnp.zeros((max_slots, 2), jnp.uint32)
+        self._prefill_jit = jax.jit(self._prefill_impl)
+        # donate the carried state (slot cache, tokens, keys): every
+        # step/splice REPLACES them, so XLA may update the KV in place
+        # instead of copying the whole slot cache each call — at serving
+        # scale that copy is the step's largest memory traffic after the
+        # weights themselves
+        self._insert_jit = jax.jit(self._insert_impl, donate_argnums=(0,))
+        self._step_jit = jax.jit(self._step_impl, donate_argnums=(1, 2, 4))
+        self._sample1_jit = jax.jit(self._sample)
+
+    # ---- compiled programs ------------------------------------------------
+
+    def _prefill_impl(self, params, cache0, toks, plen):
+        """Whole padded prompt in one parallel pass; returns the filled
+        batch-1 cache and the logits at the LAST REAL position (the
+        distribution of the first generated token)."""
+        logits, mut = self.prefill_model.apply(
+            {"params": params, "cache": cache0}, toks, train=False,
+            mutable=["cache"],
+        )
+        last = jax.lax.dynamic_slice_in_dim(logits, plen - 1, 1, axis=1)[:, 0]
+        return mut["cache"], last.astype(jnp.float32)
+
+    def _insert_impl(self, big, small, slot, plen):
+        """Splice a prefilled batch-1 cache into slot row ``slot``.
+
+        Cursor leaves are set to the TRUE prompt length (the prefill ran
+        over the padded bucket, so its own cursor reads bucket, not
+        plen); pad K/V entries ride along and are masked/overwritten by
+        construction (module docstring).
+        """
+
+        def leaf(path, bg, sm):
+            name = getattr(path[-1], "key", None)
+            if name in ("cache_index", "pos_index"):
+                return bg.at[slot].set(jnp.asarray(plen, bg.dtype))
+            if name == "slot_pos":
+                # scrub PAD ring entries (position >= plen) back to -1
+                # ("unwritten, never attendable"): the spliced ring then
+                # holds exactly what a batch-1 unpadded prefill of plen
+                # tokens would hold — the parity invariant
+                return bg.at[slot].set(jnp.where(sm < plen, sm, -1))
+            if name in ("cached_k", "cached_v"):
+                return bg.at[slot].set(sm[0])
+            raise ValueError(f"unknown cache leaf {name!r}")
+
+        return jax.tree_util.tree_map_with_path(leaf, big, small)
+
+    def _sample(self, logits, temp, keys):
+        """Greedy/temperature next-token draw, per row.
+
+        Same math as ``models.generate`` (f32 logits / temperature →
+        categorical; argmax at temperature 0) but with an independent
+        key per row, split inside the compiled program.
+        """
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        pairs = jax.vmap(partial(jax.random.split, num=2))(keys)
+        new_keys, subs = pairs[:, 0], pairs[:, 1]
+        scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
+        sampled = jax.vmap(jax.random.categorical)(subs, scaled)
+        nxt = jnp.where(temp > 0, sampled.astype(jnp.int32), greedy)
+        return nxt, new_keys
+
+    def _step_impl(self, params, cache, tok, temp, keys):
+        """One decode step over ALL slots: [S] tokens in, [S] out."""
+        logits, mut = self.decode_model.apply(
+            {"params": params, "cache": cache}, tok[:, None], train=False,
+            mutable=["cache"],
+        )
+        nxt, new_keys = self._sample(
+            logits[:, 0].astype(jnp.float32), temp, keys)
+        return mut["cache"], nxt, new_keys
+
+    # ---- host-side API (called by the scheduler loop thread) --------------
+
+    def pick_bucket(self, plen: int) -> int:
+        """Smallest warm bucket covering ``plen`` (jit caches stay warm)."""
+        for b in self.buckets:
+            if plen <= b:
+                return b
+        raise ValueError(
+            f"prompt length {plen} exceeds the largest prefill bucket "
+            f"({self.buckets[-1]}). Either shorten the prompt or construct "
+            f"the engine with a larger bucket (buckets={self.buckets}, "
+            f"max_len={self.max_len}).")
+
+    def validate_request(self, prompt_len: int, max_new_tokens: int) -> None:
+        """Admission-time shape checks — every error is actionable."""
+        if prompt_len < 1:
+            raise ValueError("prompt must be non-empty")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        self.pick_bucket(prompt_len)
+        if prompt_len + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+                f"= {prompt_len + max_new_tokens} exceeds the engine's slot "
+                f"cache (max_len={self.max_len}). Lower max_new_tokens or "
+                "rebuild the engine with a larger max_len.")
+
+    def prefill(self, slot: int, tokens: Sequence[int], temperature: float,
+                key: np.ndarray):
+        """Prefill ``tokens`` into slot ``slot`` and arm its on-device
+        sampling state; returns ``(first_token, bucket)``."""
+        plen = len(tokens)
+        bucket = self.pick_bucket(plen)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :plen] = np.asarray(tokens, np.int32)
+        small, last = self._prefill_jit(
+            self.params, self._prefill_zero, jnp.asarray(padded),
+            jnp.asarray(plen, jnp.int32))
+        self.cache = self._insert_jit(
+            self.cache, small, jnp.asarray(slot, jnp.int32),
+            jnp.asarray(plen, jnp.int32))
+        nxt, new_key = self._sample1_jit(
+            last, jnp.asarray([temperature], jnp.float32),
+            jnp.asarray(key)[None])
+        first = int(np.asarray(nxt)[0])
+        self._tok = self._tok.at[slot].set(first)
+        self._temp = self._temp.at[slot].set(float(temperature))
+        self._keys = self._keys.at[slot].set(new_key[0])
+        return first, bucket
+
+    def step_decode(self) -> np.ndarray:
+        """One compiled step over all slots; per-slot input tokens, keys
+        and temperatures live on device — the only host traffic is the
+        returned ``next[S]`` (the scheduler's stop checks/streaming).
+        Parked rows compute too; their output is discarded."""
+        self.cache, self._tok, self._keys = self._step_jit(
+            self.params, self.cache, self._tok, self._temp, self._keys)
+        return np.asarray(self._tok)
+
+    def reset_slot(self, slot: int) -> None:
+        """Park a freed slot: zero its cursor (so it cannot creep toward
+        int32 wraparound across very long serving sessions) and its
+        temperature.  Parked slots still ride the compiled step; their
+        writes/outputs are masked/discarded."""
+
+        def leaf(path, bg):
+            name = getattr(path[-1], "key", None)
+            if name in ("cache_index", "pos_index"):
+                return bg.at[slot].set(jnp.zeros((), bg.dtype))
+            return bg
+
+        self.cache = jax.tree_util.tree_map_with_path(leaf, self.cache)
+        self._temp = self._temp.at[slot].set(0.0)
+
+    def compile_stats(self) -> dict:
+        """Compile counts per program — the no-recompile steady-state
+        assertion reads ``decode_compiles == 1`` after warmup."""
+        return {
+            "decode_compiles": _jit_cache_size(self._step_jit),
+            "prefill_compiles": _jit_cache_size(self._prefill_jit),
+            "insert_compiles": _jit_cache_size(self._insert_jit),
+        }

@@ -1,0 +1,247 @@
+"""Prefill/decode scheduler: FIFO admission, per-request stopping,
+backpressure, and serving metrics.
+
+One loop drives the engine's two compiled programs:
+
+* **decode phase** — if any slot is live, ONE fixed-shape step over all
+  slots; per-slot next tokens are emitted, stop conditions checked
+  (``max_new_tokens`` / EOS), and finished requests free their slot.
+* **admit phase** — free slots are filled from the bounded FIFO queue:
+  each admission runs one bucketed prefill and splices the result into
+  its slot, so waiting requests join MID-FLIGHT without recompiling or
+  disturbing live slots.  The first generated token comes from the
+  prefill logits (that draw is the time-to-first-token).
+
+Decode-before-admit means a slot freed by an EOS in step N is re-filled
+within the same ``step()`` call — continuous batching, not gang
+scheduling.  Backpressure is the bounded queue: ``submit`` raises
+:class:`QueueFull` (the HTTP front end maps it to 429).
+
+Thread model: ``submit``/``metrics`` may be called from any thread;
+``step``/``run_until_idle`` must run on ONE driver thread (the server's
+engine loop, or the test body).
+"""
+
+from __future__ import annotations
+
+import itertools
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from .engine import LMEngine
+
+__all__ = ["Request", "Scheduler", "QueueFull"]
+
+_ids = itertools.count()
+
+
+class QueueFull(RuntimeError):
+    """Admission queue at capacity — shed load (HTTP 429)."""
+
+
+@dataclass
+class Request:
+    """One generation request riding the slot pool."""
+
+    prompt: Sequence[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    seed: int = 0
+    eos_id: Optional[int] = None
+    # called from the scheduler thread per emitted token (streaming)
+    on_token: Optional[Callable[["Request", int], None]] = None
+    id: int = field(default_factory=lambda: next(_ids))
+
+    # scheduler-owned state
+    generated: List[int] = field(default_factory=list)
+    state: str = "queued"  # queued | active | done
+    slot: Optional[int] = None
+    done: threading.Event = field(default_factory=threading.Event)
+    submitted_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+
+    def __post_init__(self):
+        self.prompt = [int(t) for t in self.prompt]
+        self._key = np.asarray(jax.random.PRNGKey(self.seed))
+
+    @property
+    def tokens(self) -> List[int]:
+        """Prompt + generated — the ``models.generate`` output layout."""
+        return list(self.prompt) + list(self.generated)
+
+
+class Scheduler:
+    def __init__(self, engine: LMEngine, max_queue: int = 64):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.engine = engine
+        self.max_queue = max_queue
+        self._queue: deque[Request] = deque()
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self.slots: List[Optional[Request]] = [None] * engine.max_slots
+        self._m = {
+            "requests_submitted": 0,
+            "requests_finished": 0,
+            "requests_rejected": 0,
+            "prefill_tokens": 0,       # real prompt tokens prefilled
+            "prefill_padded_tokens": 0,  # bucket-padded tokens computed
+            "prefill_sec": 0.0,
+            "decode_tokens": 0,        # live-slot tokens generated
+            "decode_sec": 0.0,
+            "ttft_sec_last": 0.0,
+            "ttft_sec_sum": 0.0,
+            "ttft_count": 0,
+        }
+
+    # ---- producer side (any thread) ---------------------------------------
+
+    def submit(self, req: Request) -> Request:
+        """Validate + enqueue; raises ``ValueError`` (bad shape) or
+        :class:`QueueFull` (backpressure)."""
+        self.engine.validate_request(len(req.prompt), req.max_new_tokens)
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                self._m["requests_rejected"] += 1
+                raise QueueFull(
+                    f"admission queue full ({self.max_queue} waiting)")
+            req.state = "queued"
+            req.submitted_at = time.monotonic()
+            self._queue.append(req)
+            self._m["requests_submitted"] += 1
+        self._work.set()
+        return req
+
+    def wait_for_work(self, timeout: float = 0.05) -> None:
+        """Block the driver thread until a submit arrives (or timeout)."""
+        self._work.wait(timeout)
+        self._work.clear()
+
+    # ---- driver side (one thread) -----------------------------------------
+
+    @property
+    def active_slots(self) -> int:
+        return sum(r is not None for r in self.slots)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def idle(self) -> bool:
+        return self.active_slots == 0 and self.queue_depth == 0
+
+    def step(self) -> int:
+        """One scheduler tick: decode live slots, then admit from the
+        queue into whatever is free (including slots freed THIS tick).
+        Returns the number of tokens emitted."""
+        emitted = 0
+        live = [s for s, r in enumerate(self.slots) if r is not None]
+        if live:
+            t0 = time.monotonic()
+            nxt = self.engine.step_decode()
+            self._m["decode_sec"] += time.monotonic() - t0
+            self._m["decode_tokens"] += len(live)
+            for s in live:
+                self._emit(self.slots[s], int(nxt[s]))
+                emitted += 1
+        # admit into free slots (possibly just freed by EOS above)
+        while True:
+            try:
+                free = self.slots.index(None)
+            except ValueError:
+                break
+            with self._lock:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+            t0 = time.monotonic()
+            first, bucket = self.engine.prefill(
+                free, req.prompt, req.temperature, req._key)
+            self._m["prefill_sec"] += time.monotonic() - t0
+            self._m["prefill_tokens"] += len(req.prompt)
+            self._m["prefill_padded_tokens"] += bucket
+            req.state = "active"
+            req.slot = free
+            self.slots[free] = req
+            self._emit(req, first)
+            emitted += 1
+        return emitted
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> None:
+        for _ in range(max_steps):
+            if self.idle:
+                return
+            self.step()
+        raise RuntimeError(f"scheduler did not drain in {max_steps} steps")
+
+    def generate_all(self, requests: Sequence[Request]) -> List[List[int]]:
+        """Convenience (tests/bench): submit everything, drain, return
+        each request's prompt+generated token list."""
+        for r in requests:
+            self.submit(r)
+        self.run_until_idle()
+        return [r.tokens for r in requests]
+
+    # ---- internals --------------------------------------------------------
+
+    def _emit(self, req: Request, tok: int) -> None:
+        now = time.monotonic()
+        req.generated.append(tok)
+        if req.first_token_at is None:
+            req.first_token_at = now
+            if req.submitted_at is not None:
+                ttft = now - req.submitted_at
+                self._m["ttft_sec_last"] = ttft
+                self._m["ttft_sec_sum"] += ttft
+                self._m["ttft_count"] += 1
+        if req.on_token is not None:
+            try:
+                req.on_token(req, tok)
+            except Exception as e:  # noqa: BLE001
+                # a streaming callback must not be able to kill the
+                # whole serving loop (or skip this request's stop check)
+                print(f"serve: on_token callback failed for request "
+                      f"{req.id}: {type(e).__name__}: {e}", file=sys.stderr)
+        hit_eos = req.eos_id is not None and tok == req.eos_id
+        if hit_eos or len(req.generated) >= req.max_new_tokens:
+            self._finish(req)
+
+    def _finish(self, req: Request) -> None:
+        req.state = "done"
+        req.finished_at = time.monotonic()
+        if req.slot is not None:
+            self.slots[req.slot] = None
+            self.engine.reset_slot(req.slot)
+            req.slot = None
+        self._m["requests_finished"] += 1
+        req.done.set()
+
+    def metrics(self) -> dict:
+        """Serving counters + derived rates + engine compile stats."""
+        with self._lock:
+            m = dict(self._m)
+            m["queue_depth"] = len(self._queue)
+        m["active_slots"] = self.active_slots
+        m["max_slots"] = self.engine.max_slots
+        m["prefill_tokens_per_sec"] = (
+            m["prefill_tokens"] / m["prefill_sec"] if m["prefill_sec"] else 0.0
+        )
+        m["decode_tokens_per_sec"] = (
+            m["decode_tokens"] / m["decode_sec"] if m["decode_sec"] else 0.0
+        )
+        n = m["ttft_count"]  # every request that GOT a first token —
+        # dividing by requests_finished would overstate the average
+        # whenever active requests have already produced TTFT samples
+        m["ttft_sec_avg"] = m["ttft_sec_sum"] / n if n else 0.0
+        m.update(self.engine.compile_stats())
+        return m

@@ -1,0 +1,314 @@
+"""HTTP front end for the continuous-batching engine.
+
+Stdlib-only (the bin/serve.py webcam-demo pattern, scaled to LM
+serving): a ``ThreadingHTTPServer`` accepts requests on many threads,
+every generation is enqueued onto ONE scheduler loop thread, and
+streaming responses ride chunked transfer encoding.
+
+Routes:
+
+* ``POST /v1/generate`` — JSON body::
+
+      {"prompt": "text"            # byte-level (vocab >= 256), OR
+       "prompt_tokens": [1, 2],    # explicit token ids
+       "max_tokens": 64,           # new tokens to generate
+       "temperature": 0.0,         # 0 = greedy (parity with generate())
+       "seed": 0, "eos": null,     # optional sampling seed / stop token
+       "stream": false}            # chunked per-token streaming
+
+  Non-streaming responses carry ``tokens`` (prompt+generated),
+  ``generated``, decoded ``text`` for byte-level vocabs, and per-request
+  timings.  Streaming responses emit one JSON line per token and a final
+  ``{"done": true, ...}`` line.  A full admission queue returns **429**
+  (backpressure), bad shapes return 400 with the engine's actionable
+  message.
+* ``GET /healthz`` — liveness + slot/queue occupancy.
+* ``GET /metrics`` — Prometheus text: queue depth, active slots,
+  prefill/decode tokens-per-sec, time-to-first-token, compile counts.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+from .scheduler import QueueFull, Request, Scheduler
+
+__all__ = ["LMServer", "serve_lm"]
+
+
+class LMServer:
+    """Scheduler loop thread + HTTP handler factory."""
+
+    def __init__(self, scheduler: Scheduler, vocab: int,
+                 request_timeout: float = 600.0):
+        self.scheduler = scheduler
+        self.vocab = vocab
+        self.request_timeout = request_timeout
+        self._stop = threading.Event()
+        self._loop_thread: Optional[threading.Thread] = None
+        self.loop_errors = 0
+        self.last_loop_error: Optional[str] = None
+
+    # ---- engine loop ------------------------------------------------------
+
+    def start_loop(self) -> None:
+        if self._loop_thread is not None:
+            return
+        self._loop_thread = threading.Thread(
+            target=self._loop, name="lm-engine-loop", daemon=True)
+        self._loop_thread.start()
+
+    def stop_loop(self) -> None:
+        self._stop.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=10)
+            self._loop_thread = None
+        self._stop.clear()
+
+    def _loop(self) -> None:
+        import sys
+        import traceback
+
+        sched = self.scheduler
+        while not self._stop.is_set():
+            try:
+                if sched.idle:
+                    sched.wait_for_work(0.05)
+                    continue
+                sched.step()
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                # a dead loop with a healthy-looking server is a silent
+                # permanent outage: log, count (surfaced by /healthz and
+                # /metrics), back off a beat, keep serving
+                self.loop_errors += 1
+                self.last_loop_error = f"{type(e).__name__}: {e}"
+                traceback.print_exc(file=sys.stderr)
+                self._stop.wait(0.1)
+
+    # ---- helpers ----------------------------------------------------------
+
+    def _decode_text(self, toks) -> Optional[str]:
+        if self.vocab != 256:
+            return None
+        from ..data import ByteTextDataset
+
+        return ByteTextDataset.decode(toks)
+
+    def _parse_request(self, body: dict) -> Request:
+        if "prompt" in body and "prompt_tokens" in body:
+            raise ValueError("pass prompt OR prompt_tokens, not both")
+        if "prompt" in body:
+            if self.vocab < 256:
+                raise ValueError(
+                    "text prompts are byte-encoded and need vocab >= 256; "
+                    "this model has vocab "
+                    f"{self.vocab} — pass prompt_tokens instead")
+            prompt = list(str(body["prompt"]).encode("utf-8"))
+        elif "prompt_tokens" in body:
+            prompt = [int(t) for t in body["prompt_tokens"]]
+            if prompt and (min(prompt) < 0 or max(prompt) >= self.vocab):
+                raise ValueError(
+                    f"prompt tokens must be in [0, {self.vocab})")
+        else:
+            raise ValueError("body needs prompt or prompt_tokens")
+        temperature = float(body.get("temperature", 0.0))
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        eos = body.get("eos")
+        return Request(
+            prompt=prompt,
+            max_new_tokens=int(body.get("max_tokens", 64)),
+            temperature=temperature,
+            seed=int(body.get("seed", 0)),
+            eos_id=None if eos is None else int(eos),
+        )
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition format (float-valued gauges)."""
+        m = self.scheduler.metrics()
+        lines = []
+        for k in sorted(m):
+            v = m[k]
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            lines.append(f"fdtpu_serve_{k} {float(v):g}")
+        return "\n".join(lines) + "\n"
+
+    # ---- HTTP -------------------------------------------------------------
+
+    def make_handler(self):
+        import http.server
+
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, code, obj):
+                self._send(code, json.dumps(obj).encode(), "application/json")
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    sched = outer.scheduler
+                    loop = outer._loop_thread
+                    alive = loop is not None and loop.is_alive()
+                    body = {
+                        "ok": alive,
+                        "active_slots": sched.active_slots,
+                        "max_slots": sched.engine.max_slots,
+                        "queue_depth": sched.queue_depth,
+                        "loop_errors": outer.loop_errors,
+                    }
+                    if outer.last_loop_error:
+                        body["last_loop_error"] = outer.last_loop_error
+                    self._send_json(200 if alive else 503, body)
+                elif self.path == "/metrics":
+                    self._send(200, outer.metrics_text().encode(),
+                               "text/plain; version=0.0.4")
+                else:
+                    self._send_json(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/v1/generate":
+                    self._send_json(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(body, dict):
+                        raise ValueError("body must be a JSON object")
+                    req = outer._parse_request(body)
+                except (ValueError, TypeError, json.JSONDecodeError) as e:
+                    # TypeError covers type-malformed fields (e.g.
+                    # prompt_tokens: 5) — still the client's 400, not a 500
+                    self._send_json(400, {"error": str(e)})
+                    return
+                stream = bool(body.get("stream", False))
+                if stream:
+                    self._stream(req)
+                else:
+                    self._blocking(req)
+
+            def _submit(self, req) -> bool:
+                try:
+                    outer.scheduler.submit(req)
+                    return True
+                except QueueFull as e:
+                    self.send_response(429)
+                    self.send_header("Retry-After", "1")
+                    body = json.dumps({"error": str(e)}).encode()
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except ValueError as e:
+                    self._send_json(400, {"error": str(e)})
+                return False
+
+            def _result(self, req) -> dict:
+                out = {
+                    "id": req.id,
+                    "tokens": req.tokens,
+                    "generated": list(req.generated),
+                }
+                text = outer._decode_text(req.tokens)
+                if text is not None:
+                    out["text"] = text
+                if req.first_token_at and req.submitted_at:
+                    out["ttft_ms"] = round(
+                        (req.first_token_at - req.submitted_at) * 1e3, 2)
+                if req.finished_at and req.first_token_at:
+                    dt = req.finished_at - req.first_token_at
+                    if dt > 0 and len(req.generated) > 1:
+                        out["decode_tokens_per_sec"] = round(
+                            (len(req.generated) - 1) / dt, 2)
+                return out
+
+            def _blocking(self, req):
+                if not self._submit(req):
+                    return
+                if not req.done.wait(outer.request_timeout):
+                    self._send_json(504, {"error": "generation timed out"})
+                    return
+                self._send_json(200, self._result(req))
+
+            def _stream(self, req):
+                import queue as _q
+
+                toks: _q.Queue = _q.Queue()
+                req.on_token = lambda r, t: toks.put(t)
+                if not self._submit(req):
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/jsonlines")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(obj):
+                    data = (json.dumps(obj) + "\n").encode()
+                    self.wfile.write(f"{len(data):x}\r\n".encode())
+                    self.wfile.write(data + b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    import time as _time
+
+                    deadline = _time.monotonic() + outer.request_timeout
+                    while _time.monotonic() < deadline:
+                        try:
+                            t = toks.get(timeout=0.05)
+                        except _q.Empty:
+                            # on_token fires BEFORE done is set; only a
+                            # drained queue + done means truly finished
+                            if req.done.is_set() and toks.empty():
+                                break
+                            continue
+                        chunk({"token": int(t)})
+                    if req.done.is_set():
+                        chunk({"done": True, **self._result(req)})
+                    else:
+                        # deadline hit with the request still running:
+                        # report the truncation (the blocking path's 504)
+                        # instead of masquerading as a clean completion
+                        chunk({"done": False,
+                               "error": "generation timed out",
+                               **self._result(req)})
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client went away; the request still drains
+                finally:
+                    try:
+                        self.wfile.write(b"0\r\n\r\n")
+                        self.wfile.flush()
+                    except OSError:
+                        pass
+
+        return Handler
+
+    def serve(self, host: str = "127.0.0.1", port: int = 8000):
+        """Build the HTTP server (started loop included); caller runs
+        ``serve_forever`` — the bin/serve.py pattern, so tests can drive
+        the server in a thread."""
+        import http.server
+
+        self.start_loop()
+        return http.server.ThreadingHTTPServer((host, port),
+                                               self.make_handler())
+
+
+def serve_lm(scheduler: Scheduler, vocab: int, host: str = "127.0.0.1",
+             port: int = 8000, request_timeout: float = 600.0):
+    """One-call wiring: ``(LMServer, ThreadingHTTPServer)``."""
+    srv = LMServer(scheduler, vocab, request_timeout=request_timeout)
+    return srv, srv.serve(host, port)

@@ -59,13 +59,28 @@ class NullLogger:
 class WandbLogger:
     """Weights & Biases sink, import-gated like the reference's Requires
     hook (src/FluxDistributed.jl:22-24).  Raises ImportError at
-    construction if wandb isn't installed."""
+    construction if wandb isn't installed.
 
-    def __init__(self, **init_kwargs):
+    ``config`` pushes the RUN CONFIGURATION (architecture, spmd mode,
+    optimizer hyperparameters — whatever dict the driver assembles) at
+    init, the reference's ``WandbLogger(...; config=...)`` behavior
+    (src/loggers/wandb.jl:1): runs are comparable in the W&B UI by what
+    they trained, not just by their metric curves.  ``log_config``
+    merges additions later (e.g. values only known after mesh build).
+    """
+
+    def __init__(self, config: Mapping[str, Any] | None = None,
+                 **init_kwargs):
         import wandb  # gated import — absent from this environment is fine
 
         self._wandb = wandb
+        if config is not None:
+            init_kwargs.setdefault("config", dict(config))
         self.run = wandb.init(**init_kwargs)
+
+    def log_config(self, config: Mapping[str, Any]) -> None:
+        """Merge more run config after init (wandb.config.update)."""
+        self.run.config.update(dict(config), allow_val_change=True)
 
     def log(self, metrics: Mapping[str, Any], step: int) -> None:
         self._wandb.log(dict(metrics), step=step)

@@ -54,6 +54,33 @@ def test_wandb_logger_uses_wandb_module(monkeypatch):
     assert calls["log"] == [({"loss": 1.5}, 3)]
 
 
+def test_wandb_logger_pushes_run_config(monkeypatch):
+    """config= rides wandb.init at construction (the reference's
+    WandbLogger(...; config=...) behavior, src/loggers/wandb.jl:1) and
+    log_config merges later additions via run.config.update."""
+    calls = {"init": [], "update": []}
+
+    class _Cfg:
+        def update(self, d, allow_val_change=False):
+            calls["update"].append((d, allow_val_change))
+
+    class _Run:
+        config = _Cfg()
+
+    stub = types.ModuleType("wandb")
+    stub.init = lambda **kw: (calls["init"].append(kw), _Run())[1]
+    stub.log = lambda *a, **kw: None
+    monkeypatch.setitem(sys.modules, "wandb", stub)
+
+    from fluxdistributed_tpu.train.logging import WandbLogger
+
+    cfg = {"model": "lm_small", "spmd": "fsdp", "lr": 3e-4, "opt": "adamw"}
+    lg = WandbLogger(project="p", config=cfg)
+    assert calls["init"] == [{"project": "p", "config": cfg}]
+    lg.log_config({"mesh": {"data": 8}})
+    assert calls["update"] == [({"mesh": {"data": 8}}, True)]
+
+
 def test_docs_site_config_complete():
     """mkdocs.yml (the Documenter-site analog, ref docs/make.jl) stays in
     sync with docs/: every nav entry exists, every docs page is in nav."""

@@ -10,6 +10,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# tier-2 (slow): ConvNeXt compiles + torch parity — the tier-1 iteration loop must fit the
+# 870s verify window (ROADMAP); CI's slow job still runs this file
+pytestmark = pytest.mark.slow
+
 from fluxdistributed_tpu import mesh as mesh_lib
 from fluxdistributed_tpu import optim, sharding
 from fluxdistributed_tpu.models import (

@@ -11,6 +11,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# tier-2 (slow): GPipe train/forward compiles on the 8-device mesh — the tier-1 iteration loop must fit the
+# 870s verify window (ROADMAP); CI's slow job still runs this file
+pytestmark = pytest.mark.slow
+
 from fluxdistributed_tpu import mesh as mesh_lib, optim
 from fluxdistributed_tpu.ops import logitcrossentropy, onehot
 from fluxdistributed_tpu.parallel.dp import TrainState

@@ -12,6 +12,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
+# tier-2 (slow): full train-run reproducibility (several trainer runs) — the tier-1 iteration loop must fit the
+# 870s verify window (ROADMAP); CI's slow job still runs this file
+pytestmark = pytest.mark.slow
 
 import fluxdistributed_tpu as fd
 from fluxdistributed_tpu import optim, sharding

@@ -11,6 +11,10 @@ from __future__ import annotations
 import numpy as np
 import pytest
 
+# tier-2 (slow): torch imports + full-model weight-parity compiles — the tier-1 iteration loop must fit the
+# 870s verify window (ROADMAP); CI's slow job still runs this file
+pytestmark = pytest.mark.slow
+
 torch = pytest.importorskip("torch")
 
 import jax.numpy as jnp  # noqa: E402
