@@ -8,6 +8,14 @@ This is the "where do the milliseconds go" tool for docs/benchmarks.md.
 
 Usage: python benchmarks/trace_analysis.py [--steps 5] [--batch 256]
        [--model resnet50] [--top 30] [--platform cpu]
+
+``--analyze-only`` skips the synthetic capture and analyzes an EXISTING
+trace — a production ``train(..., profile_dir=...)`` capture (pass the
+``profile_dir``; the profiler's ``plugins/profile/<session>/`` nesting
+is searched recursively), a dir from a previous ``--trace-dir`` run, or
+a single ``.xplane.pb`` file.  One analyzer for bench traces and
+trainer traces, so a production step breakdown and a benchmark step
+breakdown are directly comparable (docs/benchmarks.md "Trace handoff").
 """
 
 from __future__ import annotations
@@ -87,14 +95,109 @@ def classify(name: str) -> str:
     return "other"
 
 
-def analyze(trace_dir: str, top: int):
-    from jax.profiler import ProfileData
+def resolve_xplane(path: str) -> str:
+    """Map a user-supplied trace path to ONE ``.xplane.pb`` file.
 
-    paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
-                             recursive=True))
+    Accepts a trainer ``profile_dir``, a ``--trace-dir`` from a capture
+    run, or a direct ``.xplane.pb`` path.  A dir holding several capture
+    sessions (e.g. a long-running trainer profiled twice) resolves to
+    the NEWEST by mtime — and says so, so nobody silently analyzes last
+    week's run.
+    """
+    if os.path.isfile(path):
+        if not path.endswith(".xplane.pb"):
+            raise SystemExit(
+                f"{path} is a file but not an .xplane.pb — pass the "
+                "profiler's xplane protobuf (or its directory)")
+        return path
+    paths = glob.glob(os.path.join(path, "**", "*.xplane.pb"),
+                      recursive=True)
     if not paths:
-        raise SystemExit(f"no xplane.pb under {trace_dir}")
-    pd = ProfileData.from_file(paths[-1])
+        raise SystemExit(
+            f"no .xplane.pb under {path} — expected a jax.profiler "
+            "capture dir (benchmarks --trace-dir, or a trainer "
+            "profile_dir from train(..., profile_dir=...))")
+    paths.sort(key=os.path.getmtime)
+    if len(paths) > 1:
+        print(f"note: {len(paths)} capture sessions under {path}; "
+              f"analyzing the newest ({os.path.relpath(paths[-1], path)})\n")
+    return paths[-1]
+
+
+def _load_profile(xplane: str):
+    """Parse an ``.xplane.pb`` into a planes/lines/events view.
+
+    Newer jax ships ``jax.profiler.ProfileData``; older toolchains (this
+    image's jax 0.4.x) don't — there the TSL xplane protobuf that
+    tensorflow carries parses the same file.  Both are adapted to the
+    ProfileData attribute shape (``planes[].lines[].events[]`` with
+    ``name``/``duration_ns``) so ``analyze`` has ONE consumer path.
+    """
+    try:
+        from jax.profiler import ProfileData
+
+        return ProfileData.from_file(xplane)
+    except ImportError:
+        pass
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError as e:
+        raise SystemExit(
+            "cannot parse the trace: this jax has no "
+            "jax.profiler.ProfileData and the tensorflow xplane proto "
+            f"fallback is unavailable ({e}); upgrade jax or install "
+            "tensorflow to analyze traces"
+        )
+
+    class _Event:
+        __slots__ = ("name", "duration_ns")
+
+        def __init__(self, name, duration_ns):
+            self.name = name
+            self.duration_ns = duration_ns
+
+    class _Line:
+        __slots__ = ("name", "events")
+
+        def __init__(self, name, events):
+            self.name = name
+            self.events = events
+
+    class _Plane:
+        __slots__ = ("name", "lines")
+
+        def __init__(self, name, lines):
+            self.name = name
+            self.lines = lines
+
+    class _Profile:
+        __slots__ = ("planes",)
+
+        def __init__(self, planes):
+            self.planes = planes
+
+    space = xplane_pb2.XSpace()
+    with open(xplane, "rb") as f:
+        space.ParseFromString(f.read())
+    planes = []
+    for plane in space.planes:
+        meta = plane.event_metadata  # id -> XEventMetadata
+        lines = []
+        for line in plane.lines:
+            events = []
+            for ev in line.events:
+                md = meta.get(ev.metadata_id)
+                name = (md.name or md.display_name) if md is not None else ""
+                # XEvent carries picoseconds; ProfileData exposes ns
+                events.append(_Event(name, ev.duration_ps / 1e3))
+            lines.append(_Line(line.name, events))
+        planes.append(_Plane(plane.name, lines))
+    return _Profile(planes)
+
+
+def analyze(trace_path: str, top: int):
+    xplane = resolve_xplane(trace_path)
+    pd = _load_profile(xplane)
 
     # pick accelerator device planes; on CPU there is no device plane, so
     # fall back to the host plane and SAY SO — host traces mix Python
@@ -130,7 +233,7 @@ def analyze(trace_dir: str, top: int):
                 counts[ev.name] += 1
 
     total = sum(durs.values())
-    print(f"trace: {paths[-1]}")
+    print(f"trace: {xplane}")
     print(f"planes analyzed: {[p.name for p in best]}")
     print(f"total device-op time: {total:.1f} ms (all steps, incl. overlap)\n")
 
@@ -157,8 +260,10 @@ def main():
     ap.add_argument("--s2d", action="store_true",
                     help="trace the space_to_depth-stem model instead")
     ap.add_argument("--trace-dir", default=None)
-    ap.add_argument("--analyze-only", default=None,
-                    help="skip capture; analyze this trace dir")
+    ap.add_argument("--analyze-only", default=None, metavar="PATH",
+                    help="skip capture; analyze an existing trace: a "
+                         "trainer profile_dir, a --trace-dir, or a "
+                         "single .xplane.pb file")
     args = ap.parse_args()
     trace_dir = args.analyze_only or capture(args)
     analyze(trace_dir, args.top)

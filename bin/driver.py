@@ -146,6 +146,33 @@ def build_parser() -> argparse.ArgumentParser:
                         "all devices)")
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--wandb", action="store_true", help="log to Weights & Biases")
+    # observability (fluxdistributed_tpu.obs): live endpoints + traces
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve GET /metrics (Prometheus text: step counter, "
+                        "per-phase histograms, compile counts, OOM skips, "
+                        "prefetch depth) and GET /healthz on this port for "
+                        "the duration of the run (coordinator host only — "
+                        "the serve/server.py stdlib-HTTP pattern)")
+    p.add_argument("--trace-events", default=None, metavar="PATH",
+                   help="record nested step-phase spans (data_wait/h2d/"
+                        "dispatch/device/eval/checkpoint) and write "
+                        "Chrome/Perfetto trace-event JSON here at exit; "
+                        "implies per-step device sync so device time is "
+                        "honestly attributed")
+    p.add_argument("--metrics-jsonl", default=None, metavar="PATH",
+                   help="append registry snapshots (JSON lines) here at the "
+                        "print cadence — offline run diffing without a "
+                        "Prometheus server")
+    p.add_argument("--steady-after", type=int, default=None, metavar="N",
+                   help="declare XLA warmup over after N cycles: any later "
+                        "compile is counted + warned as a steady-state "
+                        "recompile (fdtpu_jax_steady_recompiles_total)")
+    p.add_argument("--watchdog-factor", type=float, default=5.0,
+                   help="stall watchdog threshold as a multiple of the "
+                        "rolling-median step time (warns + flips /healthz "
+                        "to 503 when no step lands inside it; eval and "
+                        "checkpoint phases are exempt). 0 disables the "
+                        "watchdog")
     # manual cluster bring-up (CPU fake cluster / debugging)
     p.add_argument("--coordinator", default=None, help="coordinator host:port")
     p.add_argument("--num-processes", type=int, default=None)
@@ -453,16 +480,58 @@ def main(argv=None) -> int:
         # non-coordinators stay quiet unless --verbose
         logger = ConsoleLogger() if (multihost.is_coordinator() or args.verbose) else NullLogger()
 
-    train(
-        task,
-        print_every=args.print_every,
-        eval_every=args.eval_every,
-        topk=() if is_lm else (1, 5, 10),
-        logger=logger,
-        checkpoint_dir=args.checkpoint_dir,
-        checkpoint_every=args.checkpoint_every,
-        verbose=args.verbose,
+    # Unified observability: phase metrics + compile counters always on;
+    # spans/watchdog/endpoints per flags.  The metrics endpoint binds on
+    # the coordinator only (a fake cluster runs many processes per host —
+    # N processes racing for one port helps nobody).
+    from fluxdistributed_tpu.obs import (
+        Observation, SpanTracer, StepWatchdog, get_registry,
+        start_metrics_server,
     )
+
+    observation = Observation(
+        tracer=SpanTracer() if args.trace_events else None,
+        watchdog=(StepWatchdog(factor=args.watchdog_factor)
+                  if args.watchdog_factor else None),
+        trace_path=args.trace_events,
+        device_sync=bool(args.trace_events),
+        steady_after=args.steady_after,
+        jsonl_path=args.metrics_jsonl,
+    )
+    metrics_srv = None
+    if args.metrics_port is not None and multihost.is_coordinator():
+        reg = get_registry()
+
+        def _health():
+            return {
+                "ok": reg.value("fdtpu_watchdog_stalled") < 1,
+                "steps": reg.value("fdtpu_train_steps_total"),
+                "oom_skipped": reg.value("fdtpu_train_oom_skipped_total"),
+                "compiles": reg.value("fdtpu_jax_compiles_total"),
+                "steady_recompiles": reg.value(
+                    "fdtpu_jax_steady_recompiles_total"),
+            }
+
+        metrics_srv = start_metrics_server(
+            port=args.metrics_port, health_fn=_health)
+        print(f"metrics: http://0.0.0.0:{metrics_srv.port}/metrics "
+              f"(+ /healthz)")
+
+    try:
+        train(
+            task,
+            print_every=args.print_every,
+            eval_every=args.eval_every,
+            topk=() if is_lm else (1, 5, 10),
+            logger=logger,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+            verbose=args.verbose,
+            observation=observation,
+        )
+    finally:
+        if metrics_srv is not None:
+            metrics_srv.stop()
     multihost.sync_global_devices("train_done")
     if args.final_eval:
         from fluxdistributed_tpu.train import evaluate

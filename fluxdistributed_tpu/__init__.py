@@ -14,7 +14,7 @@ below are the currently implemented subset.
 """
 
 from . import compat  # noqa: F401 — must precede any jax-surface use
-from . import data, mesh, models, ops, optim, parallel, sharding, tree
+from . import data, mesh, models, obs, ops, optim, parallel, sharding, tree
 
 
 def __getattr__(name):
@@ -55,6 +55,7 @@ __all__ = [
     "data",
     "mesh",
     "models",
+    "obs",
     "ops",
     "optim",
     "parallel",

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Iterator, Optional
 
 import jax
@@ -142,6 +143,28 @@ class PrefetchLoader:
         self.chunk = chunk
         self.sharding = NamedSharding(mesh, P(axis))
         self._chunk_sharding = NamedSharding(mesh, P(None, axis))
+        # observability: queue depth + h2d timing land in the process
+        # registry so /metrics can answer "is the input pipeline keeping
+        # up"; a tracer (set by train() when span tracing is on) adds
+        # h2d spans on the worker threads' own timeline rows
+        from ..obs import get_registry
+
+        reg = get_registry()
+        self.tracer = None
+        self._m_depth = reg.gauge(
+            "fdtpu_data_prefetch_depth",
+            "device-ready batches waiting in the prefetch queue "
+            "(0 at read time = the train loop is data-bound)")
+        self._m_h2d = reg.histogram(
+            "fdtpu_data_h2d_seconds",
+            "seconds per batch for host->device transfer (device_put "
+            "inside a prefetch worker, overlapped with compute)")
+        self._m_assemble = reg.histogram(
+            "fdtpu_data_assemble_seconds",
+            "seconds per batch for host-side assembly (sampling, "
+            "decode, one-hot, transform)")
+        self._m_batches = reg.counter(
+            "fdtpu_data_batches_total", "batches produced by the loader")
         # Multi-host: each process assembles only its rows of the global
         # batch (the analog of each reference worker sampling its own
         # minibatch, src/sync.jl:135); jax.make_array_from_process_local_data
@@ -236,7 +259,19 @@ class PrefetchLoader:
                     # device_put from a worker thread: transfer overlaps
                     # the consumer's compute, like the reference's
                     # prefetch tasks
-                    item = (i, self._put(self._make_item(i)), None)
+                    t0 = time.perf_counter()
+                    host = self._make_item(i)
+                    t1 = time.perf_counter()
+                    self._m_assemble.observe(t1 - t0)
+                    tracer = self.tracer
+                    if tracer is not None:
+                        with tracer.span("h2d", batch=i):
+                            dev = self._put(host)
+                    else:
+                        dev = self._put(host)
+                    self._m_h2d.observe(time.perf_counter() - t1)
+                    self._m_batches.inc()
+                    item = (i, dev, None)
                 except Exception as e:  # surface to the consumer, don't die silently
                     item = (i, None, e)
                 while not stop.is_set():
@@ -268,6 +303,9 @@ class PrefetchLoader:
                             "prefetch worker failed while assembling a batch"
                         ) from err
                     pending[i] = batch
+                # ready-ahead depth as the consumer sees it: queued items
+                # plus out-of-order arrivals already buffered
+                self._m_depth.set(q.qsize() + len(pending) - 1)
                 yield pending.pop(next_idx)
                 next_idx += 1
                 ahead.release()

@@ -1,0 +1,117 @@
+"""Unified observability layer (ROADMAP: the instrumentation substrate
+every perf PR reports through).
+
+One registry, four producers, three consumers:
+
+* :mod:`.metrics` — process-wide Counter/Gauge/Histogram registry with
+  Prometheus text exposition and a JSONL snapshot sink;
+* :mod:`.spans` — contextvar-nested step-phase spans exporting
+  Chrome/Perfetto trace-event JSON;
+* :mod:`.jaxmon` — ``jax.monitoring`` listeners: compile counts/seconds
+  and steady-state recompile flagging;
+* :mod:`.watchdog` — rolling-median heartbeat stall detection (+ the
+  OOM-skip counter);
+* :mod:`.server` — stdlib-HTTP ``/metrics`` + ``/healthz`` (the
+  training-side analog of the LM server's endpoints).
+
+:class:`Observation` bundles the per-run pieces for the trainer:
+``train(task, observation=Observation.full(trace_path="run.trace.json"))``
+gets phase spans, a stall watchdog, per-step device sync timing and a
+trace file; the default (``None``) still feeds step counters, phase
+histograms and compile counts into the process registry for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from . import jaxmon
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    JsonlSink,
+    Registry,
+    get_registry,
+)
+from .server import MetricsServer, start_metrics_server
+from .spans import SpanTracer, current_span
+from .watchdog import StepWatchdog
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "JsonlSink",
+    "MetricsServer",
+    "Observation",
+    "Registry",
+    "SpanTracer",
+    "StepWatchdog",
+    "current_span",
+    "get_registry",
+    "jaxmon",
+    "start_metrics_server",
+]
+
+
+@dataclasses.dataclass
+class Observation:
+    """What the training loop should instrument, bundled.
+
+    Attributes
+    ----------
+    registry: where counters/histograms live (default: process registry)
+    tracer: span tracer, or None for metrics-only (no timeline buffer)
+    watchdog: stall watchdog, or None; ``train`` starts/stops it
+    trace_path: write the tracer's Chrome trace JSON here when training
+        ends (requires ``tracer``)
+    device_sync: ``block_until_ready`` each step's outputs inside a
+        ``device`` span.  This closes the host's dispatch run-ahead, so
+        the device phase is honestly attributed — worth it when you are
+        reading a breakdown, wrong as an always-on default (it
+        serializes host and device).
+    steady_after: after this many loader items, declare
+        :func:`jaxmon.mark_steady` — any later XLA compile is flagged as
+        a steady-state recompile.  None (default) = never; eval or
+        remainder batches legitimately compile late in short runs.
+    """
+
+    registry: Registry = dataclasses.field(default_factory=get_registry)
+    tracer: Optional[SpanTracer] = None
+    watchdog: Optional[StepWatchdog] = None
+    trace_path: Optional[str] = None
+    device_sync: bool = False
+    steady_after: Optional[int] = None
+    # append a registry snapshot line here at the print cadence and at
+    # exit (offline run diffing — no Prometheus server required)
+    jsonl_path: Optional[str] = None
+
+    @classmethod
+    def default(cls) -> "Observation":
+        """Metrics-only: counters + phase histograms in the process
+        registry; no span buffer, no watchdog thread, no device sync."""
+        return cls()
+
+    @classmethod
+    def full(
+        cls,
+        trace_path: Optional[str] = None,
+        registry: Optional[Registry] = None,
+        watchdog_factor: float = 5.0,
+        steady_after: Optional[int] = None,
+        jsonl_path: Optional[str] = None,
+    ) -> "Observation":
+        """Everything on: spans (the trainer feeds the phase histogram
+        from the same brackets), stall watchdog, per-step device sync."""
+        registry = registry or get_registry()
+        return cls(
+            registry=registry,
+            tracer=SpanTracer(),
+            watchdog=StepWatchdog(factor=watchdog_factor, registry=registry),
+            trace_path=trace_path,
+            device_sync=True,
+            steady_after=steady_after,
+            jsonl_path=jsonl_path,
+        )

@@ -1,0 +1,152 @@
+"""``jax.monitoring`` listeners: live compile counters + steady-state
+recompile flagging.
+
+XLA recompiles are the silent throughput killer of a JAX service: one
+stray shape change turns a 2 ms decode step into a 30 s stall, and
+nothing in the program output says so.  JAX already emits monitoring
+events for every backend compile (``/jax/core/compile/
+backend_compile_duration`` — the same hooks TensorBoard's profiler
+consumes); this module folds them into the metrics registry:
+
+* ``fdtpu_jax_compiles_total`` / ``fdtpu_jax_compile_seconds_total`` —
+  every backend compile, count and wall seconds;
+* ``fdtpu_jax_trace_seconds_total`` — jaxpr tracing time (host-side
+  program construction, distinct from XLA compile time);
+* ``fdtpu_jax_steady_recompiles_total`` — compiles that happened AFTER
+  the caller declared steady state.  The serve engine's "ONE decode
+  compile" invariant (tests assert it offline) becomes a live metric:
+  scrape nonzero here in production and something is recompiling.
+
+Install is idempotent and process-global (JAX offers registration but
+no deregistration); the listener holds only module state and costs one
+dict lookup per COMPILE, i.e. nothing at steady state.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+from typing import Callable, Optional
+
+from .metrics import Registry, get_registry
+
+__all__ = [
+    "install",
+    "installed",
+    "mark_steady",
+    "clear_steady",
+    "steady_state",
+    "compile_count",
+    "steady_recompiles",
+]
+
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+
+_lock = threading.Lock()
+_installed = False
+_steady = False
+_registry: Optional[Registry] = None
+_warn: Callable[[str], None] = lambda msg: print(msg, file=sys.stderr)
+
+
+def _listener(event: str, duration: float, **kwargs) -> None:
+    reg = _registry
+    if reg is None:  # pragma: no cover — install() always binds one
+        return
+    if event == BACKEND_COMPILE_EVENT:
+        reg.counter(
+            "fdtpu_jax_compiles_total", "XLA backend compiles"
+        ).inc()
+        reg.counter(
+            "fdtpu_jax_compile_seconds_total", "XLA backend compile seconds"
+        ).inc(duration)
+        if _steady:
+            reg.counter(
+                "fdtpu_jax_steady_recompiles_total",
+                "compiles observed AFTER steady state was declared "
+                "(any nonzero value means something is recompiling)",
+            ).inc()
+            _warn(
+                f"obs.jaxmon: steady-state RECOMPILE ({duration:.2f}s) — "
+                "an input shape/dtype or static argument changed after "
+                "warmup; check bucket sizes and batch shapes"
+            )
+    elif event == TRACE_EVENT:
+        reg.counter(
+            "fdtpu_jax_trace_seconds_total", "jaxpr trace seconds"
+        ).inc(duration)
+
+
+def install(registry: Optional[Registry] = None,
+            warn: Optional[Callable[[str], None]] = None) -> None:
+    """Register the monitoring listener (idempotent; first registry
+    passed wins — JAX has no listener deregistration, so the binding is
+    process-lifetime)."""
+    global _installed, _registry, _warn
+    import jax.monitoring
+
+    with _lock:
+        if registry is not None and _registry is None:
+            _registry = registry
+        if _registry is None:
+            _registry = get_registry()
+        if warn is not None:
+            _warn = warn
+        if _installed:
+            return
+        # pre-register so /metrics shows explicit zeros before the
+        # first compile (absence would read as "not instrumented")
+        _registry.counter("fdtpu_jax_compiles_total", "XLA backend compiles")
+        _registry.counter(
+            "fdtpu_jax_compile_seconds_total", "XLA backend compile seconds"
+        )
+        _registry.counter(
+            "fdtpu_jax_steady_recompiles_total",
+            "compiles observed AFTER steady state was declared "
+            "(any nonzero value means something is recompiling)",
+        )
+        jax.monitoring.register_event_duration_secs_listener(_listener)
+        _installed = True
+
+
+def installed() -> bool:
+    return _installed
+
+
+def mark_steady() -> None:
+    """Declare warmup over: every compile from here on is a flagged
+    (counted + warned) steady-state recompile."""
+    global _steady
+    install()
+    _steady = True
+
+
+def clear_steady() -> None:
+    global _steady
+    _steady = False
+
+
+@contextlib.contextmanager
+def steady_state():
+    """``with jaxmon.steady_state():`` — flag recompiles inside the
+    block (restores the previous flag on exit, so nesting composes)."""
+    global _steady
+    install()
+    prev = _steady
+    _steady = True
+    try:
+        yield
+    finally:
+        _steady = prev
+
+
+def compile_count() -> float:
+    reg = _registry or get_registry()
+    return reg.value("fdtpu_jax_compiles_total")
+
+
+def steady_recompiles() -> float:
+    reg = _registry or get_registry()
+    return reg.value("fdtpu_jax_steady_recompiles_total")

@@ -1,0 +1,217 @@
+"""Stall watchdog: warn when the step cadence breaks.
+
+MPMD-style distributed training lives or dies on straggler visibility
+(arXiv:2412.14374): a hung collective, a wedged data loader or a
+preempted host shows up as... nothing — the loop simply stops printing.
+This watchdog turns "nothing" into a signal: the trainer calls
+:meth:`StepWatchdog.beat` once per step, a daemon thread compares the
+time since the last beat against ``factor ×`` the **rolling median**
+step time (median, not mean: one slow checkpoint step must not inflate
+the baseline), and a breach fires ``on_stall`` — by default a warning
+plus ``fdtpu_watchdog_stalls_total`` in the registry, so a scraper can
+alert on it remotely.
+
+The existing OOM-skip counter folds in through :meth:`note_skip`: a
+skipped batch both keeps the heartbeat alive (the loop IS making
+progress) and increments ``fdtpu_train_oom_skipped_total`` — one place
+to watch for "training is quietly throwing work away".
+
+The check logic lives in :meth:`poll` so tests drive it synchronously;
+the thread is just ``poll`` on a timer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import statistics
+import sys
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from .metrics import Registry, get_registry
+
+__all__ = ["StepWatchdog"]
+
+
+class StepWatchdog:
+    """Heartbeat monitor for a stepping loop.
+
+    Parameters
+    ----------
+    factor: stall threshold as a multiple of the rolling-median step
+        time (default 5× — cadence jitter from eval/checkpoint cycles
+        stays under it, a wedged collective does not)
+    min_interval: floor on the threshold in seconds (median decode steps
+        can be sub-millisecond; waking ops for a 5 ms "stall" is noise)
+    window: number of recent step intervals in the rolling median
+    check_every: watchdog thread poll period in seconds
+    warmup: beats to observe before arming (the first steps include
+        compiles and are not cadence)
+    on_stall: ``fn(elapsed_sec, threshold_sec)`` — defaults to a stderr
+        warning; fired ONCE per stall episode (a beat re-arms it)
+    registry: metrics registry (default: the process registry)
+    """
+
+    def __init__(
+        self,
+        factor: float = 5.0,
+        min_interval: float = 1.0,
+        window: int = 64,
+        check_every: float = 0.5,
+        warmup: int = 3,
+        on_stall: Optional[Callable[[float, float], None]] = None,
+        registry: Optional[Registry] = None,
+        name_prefix: str = "fdtpu",
+    ):
+        if factor <= 1.0:
+            raise ValueError(f"factor must be > 1, got {factor}")
+        self.factor = factor
+        self.min_interval = min_interval
+        self.check_every = check_every
+        self.warmup = warmup
+        self.on_stall = on_stall
+        self.registry = registry or get_registry()
+        self._intervals: deque = deque(maxlen=window)
+        self._lock = threading.Lock()
+        self._last_beat: Optional[float] = None
+        self._beats = 0
+        self._fired = False  # one warning per stall episode
+        self._paused = 0  # pause() nesting depth
+        # the beat ending a pause-containing iteration measures only the
+        # post-pause remainder — a bogus near-zero interval that would
+        # collapse the median; skip recording it (once)
+        self._skip_interval = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stalls = self.registry.counter(
+            f"{name_prefix}_watchdog_stalls_total",
+            "stall episodes (no step within factor x rolling-median step time)",
+        )
+        self._stalled = self.registry.gauge(
+            f"{name_prefix}_watchdog_stalled",
+            "1 while the loop is currently stalled, 0 otherwise",
+        )
+        self._skips = self.registry.counter(
+            f"{name_prefix}_train_oom_skipped_total",
+            "batches skipped by OOM fault tolerance",
+        )
+        self._stalled.set(0)
+
+    # -- loop side -----------------------------------------------------
+    def beat(self) -> None:
+        """One step completed (call from the training/serving loop)."""
+        now = time.monotonic()
+        with self._lock:
+            if self._last_beat is not None and not self._skip_interval:
+                self._intervals.append(now - self._last_beat)
+            self._skip_interval = False
+            self._last_beat = now
+            self._beats += 1
+            if self._fired:
+                self._fired = False
+                self._stalled.set(0)
+
+    def note_skip(self, n: int = 1) -> None:
+        """An OOM-skipped batch: progress (heartbeat) + a counted loss
+        of work (the reference's dead ``num_missed``, now scrapeable)."""
+        self._skips.inc(n)
+        self.beat()
+
+    @contextlib.contextmanager
+    def pause(self):
+        """Suspend stall detection around KNOWN-long legitimate work
+        (a checkpoint's synchronous device→host snapshot, a full eval
+        pass).  Without this, any in-loop phase longer than the
+        threshold reads as a stall and flips /healthz to 503 — paging
+        an operator about a checkpoint is how watchdogs get disabled.
+        The interval restarts on exit so the paused phase neither fires
+        nor pollutes the rolling median.  Nests."""
+        with self._lock:
+            self._paused += 1
+        try:
+            yield
+        finally:
+            now = time.monotonic()
+            with self._lock:
+                self._paused -= 1
+                # restart the measurement window; NEITHER the paused
+                # phase's duration NOR the post-pause remainder of this
+                # iteration may enter the cadence intervals (the first
+                # would inflate the median, the second collapse it)
+                self._last_beat = now
+                self._skip_interval = True
+
+    # -- watchdog side -------------------------------------------------
+    def threshold(self) -> Optional[float]:
+        """Current stall threshold in seconds (None while unarmed)."""
+        with self._lock:
+            if self._beats <= self.warmup or len(self._intervals) < 2:
+                return None
+            med = statistics.median(self._intervals)
+        return max(self.factor * med, self.min_interval)
+
+    def poll(self, now: Optional[float] = None) -> bool:
+        """One check; returns True iff a NEW stall episode fired.
+        (Public so tests — or a caller without threads — drive it
+        synchronously.)"""
+        thr = self.threshold()
+        with self._lock:
+            last = self._last_beat
+            already = self._fired
+            paused = self._paused > 0
+        if thr is None or last is None or already or paused:
+            return False
+        elapsed = (now if now is not None else time.monotonic()) - last
+        if elapsed <= thr:
+            return False
+        with self._lock:
+            if self._fired:  # lost the race with another poll
+                return False
+            self._fired = True
+        self._stalls.inc()
+        self._stalled.set(1)
+        if self.on_stall is not None:
+            self.on_stall(elapsed, thr)
+        else:
+            print(
+                f"obs.watchdog: STALL — no step for {elapsed:.1f}s "
+                f"(threshold {thr:.1f}s = {self.factor} x median step); "
+                "a collective, the data loader, or a checkpoint write "
+                "may be wedged",
+                file=sys.stderr,
+            )
+        return True
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.check_every):
+            try:
+                self.poll()
+            except Exception as e:  # noqa: BLE001 — a watchdog that can
+                # crash is a watchdog that silently stops watching
+                print(f"obs.watchdog: poll failed: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+
+    def start(self) -> "StepWatchdog":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="fdtpu-watchdog", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=max(1.0, 4 * self.check_every))
+            self._thread = None
+
+    def __enter__(self) -> "StepWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

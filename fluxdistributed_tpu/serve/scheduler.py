@@ -35,9 +35,15 @@ from typing import Callable, List, Optional, Sequence
 import jax
 import numpy as np
 
+from ..obs.metrics import Registry
 from .engine import LMEngine
 
 __all__ = ["Request", "Scheduler", "QueueFull"]
+
+# every serving series carries this prefix in Prometheus exposition;
+# Scheduler.metrics() returns the same series WITHOUT it (the dict API
+# predates the shared registry and its keys are stable)
+METRIC_PREFIX = "fdtpu_serve_"
 
 _ids = itertools.count()
 
@@ -79,7 +85,13 @@ class Request:
 
 
 class Scheduler:
-    def __init__(self, engine: LMEngine, max_queue: int = 64):
+    """``registry=None`` builds a PRIVATE :class:`~..obs.Registry` per
+    scheduler — engine instances stay isolated (tests spin several per
+    process); pass a shared registry (e.g. ``obs.get_registry()``) to
+    co-expose serving metrics with trainer/jax metrics on one scrape."""
+
+    def __init__(self, engine: LMEngine, max_queue: int = 64,
+                 registry: Optional[Registry] = None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.engine = engine
@@ -88,19 +100,66 @@ class Scheduler:
         self._lock = threading.Lock()
         self._work = threading.Event()
         self.slots: List[Optional[Request]] = [None] * engine.max_slots
-        self._m = {
-            "requests_submitted": 0,
-            "requests_finished": 0,
-            "requests_rejected": 0,
-            "prefill_tokens": 0,       # real prompt tokens prefilled
-            "prefill_padded_tokens": 0,  # bucket-padded tokens computed
-            "prefill_sec": 0.0,
-            "decode_tokens": 0,        # live-slot tokens generated
-            "decode_sec": 0.0,
-            "ttft_sec_last": 0.0,
-            "ttft_sec_sum": 0.0,
-            "ttft_count": 0,
-        }
+        self.registry = registry if registry is not None else Registry()
+        r, p = self.registry, METRIC_PREFIX
+        c, g = r.counter, r.gauge
+        self._c_submitted = c(p + "requests_submitted", "requests accepted into the queue")
+        self._c_finished = c(p + "requests_finished", "requests fully generated")
+        self._c_rejected = c(p + "requests_rejected", "requests shed with QueueFull (429)")
+        self._c_prefill_tokens = c(p + "prefill_tokens", "real prompt tokens prefilled")
+        self._c_prefill_padded = c(p + "prefill_padded_tokens", "bucket-padded tokens computed")
+        self._c_prefill_sec = c(p + "prefill_sec", "seconds spent in prefill")
+        self._c_decode_tokens = c(p + "decode_tokens", "live-slot tokens generated")
+        self._c_decode_sec = c(p + "decode_sec", "seconds spent in decode steps")
+        self._g_ttft_last = g(p + "ttft_sec_last", "most recent time-to-first-token")
+        self._c_ttft_sum = c(p + "ttft_sec_sum", "sum of TTFT seconds")
+        self._c_ttft_count = c(p + "ttft_count", "requests that produced a first token")
+        self._h_ttft = r.histogram(
+            p + "ttft_seconds", "time-to-first-token distribution")
+        # point-in-time values render at scrape time (zero hot-path cost);
+        # the compile gauges make the engine's ONE-decode-compile
+        # invariant a LIVE metric, not just an offline test assertion
+        g(p + "queue_depth", "requests waiting for a slot").set_function(
+            lambda: self.queue_depth)
+        g(p + "active_slots", "slots generating right now").set_function(
+            lambda: self.active_slots)
+        g(p + "max_slots", "slot-pool capacity").set_function(
+            lambda: self.engine.max_slots)
+        g(p + "prefill_tokens_per_sec", "prefill throughput").set_function(
+            lambda: self._rate(self._c_prefill_tokens, self._c_prefill_sec))
+        g(p + "decode_tokens_per_sec", "decode throughput").set_function(
+            lambda: self._rate(self._c_decode_tokens, self._c_decode_sec))
+        g(p + "ttft_sec_avg", "mean time-to-first-token").set_function(
+            lambda: self._rate(self._c_ttft_sum, self._c_ttft_count))
+        for key in ("decode_compiles", "prefill_compiles", "insert_compiles"):
+            g(p + key, "compiled-program count (steady state: decode "
+                       "stays at 1)").set_function(
+                lambda key=key: self.engine.compile_stats()[key])
+        self._callback_gauges = [
+            p + k for k in (
+                "queue_depth", "active_slots", "max_slots",
+                "prefill_tokens_per_sec", "decode_tokens_per_sec",
+                "ttft_sec_avg", "decode_compiles", "prefill_compiles",
+                "insert_compiles",
+            )
+        ]
+
+    @staticmethod
+    def _rate(num, den) -> float:
+        d = den.value()
+        return num.value() / d if d else 0.0
+
+    def close(self) -> None:
+        """Detach this scheduler's scrape-time callbacks from the
+        registry.  Irrelevant for the default PRIVATE registry (it dies
+        with the scheduler), but with a shared registry the callback
+        closures would otherwise pin the retired engine — and its slot
+        KV cache — forever, and keep scraping its stale stats.  Plain
+        counters stay registered deliberately: process-cumulative
+        totals are correct Prometheus semantics across restarts (a
+        successor scheduler's get-or-create continues them)."""
+        for name in self._callback_gauges:
+            self.registry.unregister(name)
 
     # ---- producer side (any thread) ---------------------------------------
 
@@ -110,13 +169,13 @@ class Scheduler:
         self.engine.validate_request(len(req.prompt), req.max_new_tokens)
         with self._lock:
             if len(self._queue) >= self.max_queue:
-                self._m["requests_rejected"] += 1
+                self._c_rejected.inc()
                 raise QueueFull(
                     f"admission queue full ({self.max_queue} waiting)")
             req.state = "queued"
             req.submitted_at = time.monotonic()
             self._queue.append(req)
-            self._m["requests_submitted"] += 1
+            self._c_submitted.inc()
         self._work.set()
         return req
 
@@ -149,8 +208,8 @@ class Scheduler:
         if live:
             t0 = time.monotonic()
             nxt = self.engine.step_decode()
-            self._m["decode_sec"] += time.monotonic() - t0
-            self._m["decode_tokens"] += len(live)
+            self._c_decode_sec.inc(time.monotonic() - t0)
+            self._c_decode_tokens.inc(len(live))
             for s in live:
                 self._emit(self.slots[s], int(nxt[s]))
                 emitted += 1
@@ -167,9 +226,9 @@ class Scheduler:
             t0 = time.monotonic()
             first, bucket = self.engine.prefill(
                 free, req.prompt, req.temperature, req._key)
-            self._m["prefill_sec"] += time.monotonic() - t0
-            self._m["prefill_tokens"] += len(req.prompt)
-            self._m["prefill_padded_tokens"] += bucket
+            self._c_prefill_sec.inc(time.monotonic() - t0)
+            self._c_prefill_tokens.inc(len(req.prompt))
+            self._c_prefill_padded.inc(bucket)
             req.state = "active"
             req.slot = free
             self.slots[free] = req
@@ -201,9 +260,10 @@ class Scheduler:
             req.first_token_at = now
             if req.submitted_at is not None:
                 ttft = now - req.submitted_at
-                self._m["ttft_sec_last"] = ttft
-                self._m["ttft_sec_sum"] += ttft
-                self._m["ttft_count"] += 1
+                self._g_ttft_last.set(ttft)
+                self._c_ttft_sum.inc(ttft)
+                self._c_ttft_count.inc()
+                self._h_ttft.observe(ttft)
         if req.on_token is not None:
             try:
                 req.on_token(req, tok)
@@ -223,25 +283,36 @@ class Scheduler:
             self.slots[req.slot] = None
             self.engine.reset_slot(req.slot)
             req.slot = None
-        self._m["requests_finished"] += 1
+        self._c_finished.inc()
         req.done.set()
 
     def metrics(self) -> dict:
-        """Serving counters + derived rates + engine compile stats."""
-        with self._lock:
-            m = dict(self._m)
-            m["queue_depth"] = len(self._queue)
-        m["active_slots"] = self.active_slots
-        m["max_slots"] = self.engine.max_slots
-        m["prefill_tokens_per_sec"] = (
-            m["prefill_tokens"] / m["prefill_sec"] if m["prefill_sec"] else 0.0
-        )
-        m["decode_tokens_per_sec"] = (
-            m["decode_tokens"] / m["decode_sec"] if m["decode_sec"] else 0.0
-        )
-        n = m["ttft_count"]  # every request that GOT a first token —
-        # dividing by requests_finished would overstate the average
-        # whenever active requests have already produced TTFT samples
-        m["ttft_sec_avg"] = m["ttft_sec_sum"] / n if n else 0.0
+        """Serving counters + derived rates + engine compile stats —
+        the pre-registry dict API, now a READ of the registry (same
+        keys as ever, sans the ``fdtpu_serve_`` exposition prefix)."""
+        m = {
+            "requests_submitted": self._c_submitted.value(),
+            "requests_finished": self._c_finished.value(),
+            "requests_rejected": self._c_rejected.value(),
+            "prefill_tokens": self._c_prefill_tokens.value(),
+            "prefill_padded_tokens": self._c_prefill_padded.value(),
+            "prefill_sec": self._c_prefill_sec.value(),
+            "decode_tokens": self._c_decode_tokens.value(),
+            "decode_sec": self._c_decode_sec.value(),
+            "ttft_sec_last": self._g_ttft_last.value(),
+            "ttft_sec_sum": self._c_ttft_sum.value(),
+            "ttft_count": self._c_ttft_count.value(),
+            "queue_depth": self.queue_depth,
+            "active_slots": self.active_slots,
+            "max_slots": self.engine.max_slots,
+            "prefill_tokens_per_sec": self._rate(
+                self._c_prefill_tokens, self._c_prefill_sec),
+            "decode_tokens_per_sec": self._rate(
+                self._c_decode_tokens, self._c_decode_sec),
+            # averaged over requests that GOT a first token — dividing
+            # by requests_finished would overstate the average whenever
+            # active requests have already produced TTFT samples
+            "ttft_sec_avg": self._rate(self._c_ttft_sum, self._c_ttft_count),
+        }
         m.update(self.engine.compile_stats())
         return m

@@ -50,6 +50,12 @@ class LMServer:
         self._loop_thread: Optional[threading.Thread] = None
         self.loop_errors = 0
         self.last_loop_error: Optional[str] = None
+        # surfaced on /metrics too: a dead engine loop behind a healthy
+        # HTTP listener is the failure mode /healthz exists for
+        self.scheduler.registry.gauge(
+            "fdtpu_serve_loop_errors",
+            "engine-loop exceptions survived (nonzero = check logs)",
+        ).set_function(lambda: self.loop_errors)
 
     # ---- engine loop ------------------------------------------------------
 
@@ -66,6 +72,14 @@ class LMServer:
             self._loop_thread.join(timeout=10)
             self._loop_thread = None
         self._stop.clear()
+
+    def close(self) -> None:
+        """Full teardown: stop the engine loop and detach this server's
+        (and its scheduler's) scrape callbacks from the registry — the
+        shared-registry retirement path (see ``Scheduler.close``)."""
+        self.stop_loop()
+        self.scheduler.registry.unregister("fdtpu_serve_loop_errors")
+        self.scheduler.close()
 
     def _loop(self) -> None:
         import sys
@@ -126,15 +140,11 @@ class LMServer:
         )
 
     def metrics_text(self) -> str:
-        """Prometheus exposition format (float-valued gauges)."""
-        m = self.scheduler.metrics()
-        lines = []
-        for k in sorted(m):
-            v = m[k]
-            if isinstance(v, bool) or not isinstance(v, (int, float)):
-                continue
-            lines.append(f"fdtpu_serve_{k} {float(v):g}")
-        return "\n".join(lines) + "\n"
+        """Prometheus text exposition — rendered by the scheduler's
+        shared metrics registry (``obs.metrics``).  Every pre-registry
+        series name (``fdtpu_serve_*``) is preserved; the registry adds
+        HELP/TYPE comment lines and histogram series."""
+        return self.scheduler.registry.prometheus_text()
 
     # ---- HTTP -------------------------------------------------------------
 

@@ -1,4 +1,4 @@
-from .logging import ConsoleLogger, Logger, current_logger, with_logger
+from .logging import ConsoleLogger, Logger, NullLogger, current_logger, with_logger
 from .trainer import TrainTask, evaluate, prepare_training, restore_training, train
 from .checkpoint import latest_step, load_checkpoint, save_checkpoint, wait_for_pending
 from .model_selection import (
@@ -10,6 +10,7 @@ from .model_selection import (
 __all__ = [
     "ConsoleLogger",
     "Logger",
+    "NullLogger",
     "current_logger",
     "with_logger",
     "TrainTask",

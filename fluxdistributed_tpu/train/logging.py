@@ -21,13 +21,47 @@ import sys
 import time
 from typing import Any, Mapping, Protocol
 
-__all__ = ["Logger", "ConsoleLogger", "WandbLogger", "with_logger", "current_logger"]
+__all__ = [
+    "Logger",
+    "ConsoleLogger",
+    "NullLogger",
+    "WandbLogger",
+    "with_logger",
+    "current_logger",
+]
 
 
 class Logger(Protocol):
     def log(self, metrics: Mapping[str, Any], step: int) -> None: ...
 
     def info(self, msg: str) -> None: ...
+
+
+def _fmt_value(v: Any) -> str:
+    """One key=value cell, whatever the value is.
+
+    Caller metrics dicts carry more than floats: numpy scalars, 0-d
+    arrays, nested dicts (per-phase breakdowns), lists (per-microbatch
+    losses), None.  Everything renders on ONE line — a metric record
+    that wraps breaks `grep step=`-ability — and nothing raises (a
+    logger that can crash the train loop is worse than no logger)."""
+    if isinstance(v, float):
+        return f"{v:.4f}"
+    if isinstance(v, (bool, int, str)) or v is None:
+        return str(v)
+    if isinstance(v, Mapping):
+        return "{" + ",".join(
+            f"{k}:{_fmt_value(x)}" for k, x in v.items()) + "}"
+    if isinstance(v, (list, tuple)):
+        return "[" + ",".join(_fmt_value(x) for x in v) + "]"
+    try:
+        return f"{float(v):.4f}"  # numpy/jax scalars and 0-d arrays
+    except (TypeError, ValueError):
+        pass
+    try:
+        return " ".join(str(v).split())  # collapse multi-line reprs
+    except Exception:  # noqa: BLE001 — even a broken __str__ must not
+        return f"<unprintable {type(v).__name__}>"  # kill the loop
 
 
 class ConsoleLogger:
@@ -38,10 +72,7 @@ class ConsoleLogger:
         self._t0 = time.time()
 
     def log(self, metrics: Mapping[str, Any], step: int) -> None:
-        kv = " ".join(
-            f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
-            for k, v in metrics.items()
-        )
+        kv = " ".join(f"{k}={_fmt_value(v)}" for k, v in metrics.items())
         print(f"[info] t={time.time() - self._t0:8.1f}s step={step} {kv}", file=self.stream)
 
     def info(self, msg: str) -> None:

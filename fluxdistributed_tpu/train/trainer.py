@@ -26,6 +26,7 @@ parity points, with their reference anchors:
 
 from __future__ import annotations
 
+import contextlib
 import copy
 import dataclasses
 import time
@@ -39,6 +40,7 @@ from .. import mesh as mesh_lib
 from .. import sharding as sharding_lib
 from .. import tree as tree_lib
 from ..data.loader import PrefetchLoader
+from ..obs import Observation, jaxmon
 from ..ops import logitcrossentropy
 from ..optim import Optimizer
 from ..parallel.dp import TrainState, flax_loss_fn, make_eval_step, make_train_step
@@ -735,6 +737,39 @@ def evaluate(
     return out
 
 
+class _PhaseClock:
+    """Step-phase bracketing: every ``with phases("dispatch"):`` block
+    observes its wall seconds into the registry's per-phase histogram
+    and, when a tracer rides along, opens a span with the same name —
+    ONE set of brackets feeds both the live ``/metrics`` percentiles
+    and the offline Chrome/Perfetto timeline."""
+
+    def __init__(self, observation: Observation):
+        self.tracer = observation.tracer
+        self.hist = observation.registry.histogram(
+            "fdtpu_train_phase_seconds",
+            "wall seconds per train-step phase "
+            "(data_wait/h2d/dispatch/device/eval/checkpoint)",
+            labelnames=("phase",),
+        )
+
+    @contextlib.contextmanager
+    def __call__(self, name: str, **args):
+        span = (
+            self.tracer.span(name, **args) if self.tracer is not None
+            else contextlib.nullcontext()
+        )
+        t0 = time.perf_counter()
+        try:
+            with span:
+                yield
+        finally:
+            # observe on the exception path too (the span does): an
+            # OOM-heavy run must not show artificially fast dispatch
+            # percentiles while its trace shows the slow truth
+            self.hist.labels(phase=name).observe(time.perf_counter() - t0)
+
+
 def train(
     task: TrainTask,
     *,
@@ -749,6 +784,7 @@ def train(
     profile_dir: Optional[str] = None,
     profile_start: int = 10,
     profile_steps: int = 5,
+    observation: Optional[Observation] = None,
 ):
     """The training loop (``train`` src/ddp_tasks.jl:174-247).
 
@@ -762,10 +798,42 @@ def train(
     and ``profile_dir`` captures a ``jax.profiler`` device trace of steps
     ``[profile_start, profile_start + profile_steps)`` for TensorBoard.
 
+    ``observation`` threads the unified observability layer
+    (:mod:`fluxdistributed_tpu.obs`) through the loop.  The default
+    (``None`` → :meth:`Observation.default`) is metrics-only: step
+    counters, per-phase wall-time histograms, compile counts and the
+    OOM-skip counter land in the process registry (scrapeable via
+    ``bin/driver.py --metrics-port``) at sub-microsecond per-step cost.
+    :meth:`Observation.full` additionally buffers nested phase SPANS
+    (exported as Chrome/Perfetto trace JSON via ``trace_path``), runs a
+    stall watchdog against the rolling-median step time, and
+    ``block_until_ready``-syncs each step so device time is honestly
+    attributed to a ``device`` phase.
+
     Returns ``(host_params, host_model_state, task)`` — the host-side
     model copy the reference returns from ``train`` (:241-246).
     """
     logger = logger or current_logger()
+    obs = observation or Observation.default()
+    phases = _PhaseClock(obs)
+    reg = obs.registry
+    jaxmon.install(reg)  # compile counters (idempotent, process-global)
+    steps_total = reg.counter(
+        "fdtpu_train_steps_total", "optimizer steps completed")
+    step_hist = reg.histogram(
+        "fdtpu_train_step_seconds",
+        "wall seconds per loader item (= steps_per_call optimizer steps)")
+    step_gauge = reg.gauge(
+        "fdtpu_train_step", "optimizer steps completed this train() call")
+    oom_total = reg.counter(
+        "fdtpu_train_oom_skipped_total",
+        "batches skipped by OOM fault tolerance")
+    sink = None
+    if obs.jsonl_path:
+        from ..obs import JsonlSink
+
+        sink = JsonlSink(obs.jsonl_path, reg)
+    marked_steady = False
     if topk is None:
         # report exactly the metrics compiled into the task's eval step
         # (loss-only for the LM pipeline modes)
@@ -776,84 +844,153 @@ def train(
     # device loop: each loader item is K stacked batches = K optimizer
     # steps in one dispatch; cadences below tick per ITEM (= per K steps)
     spc = getattr(task, "steps_per_call", 1)
+    if obs.watchdog is not None:
+        obs.watchdog.start()
+    if obs.tracer is not None and isinstance(task.loader, PrefetchLoader):
+        # prefetch workers emit their h2d spans onto the same timeline
+        # (their own thread rows in the exported trace)
+        task.loader.tracer = obs.tracer
 
-    for j, batch in enumerate(task.loader):
-        if print_every and j % print_every == 0:
-            now = time.time()
-            if j > j_mark:
-                # interval rates; the loop can only run ahead of the device
-                # by the dispatch queue, so interval averages are accurate
-                dsteps = (j - j_mark) * spc
-                dt = max(now - t_mark, 1e-9)
-                lead = jax.tree.leaves(batch)[0]
-                gbatch = int(lead.shape[1] if spc > 1 else lead.shape[0])
-                logger.log(
-                    {
-                        "steps_per_sec": round(dsteps / dt, 3),
-                        "images_per_sec": round(dsteps * gbatch / dt, 1),
-                    },
-                    j,
-                )
-                t_mark, j_mark = now, j
-            logger.info(f"cycle {j} (t={now - t_start:.1f}s)")
-        if profile_dir is not None:
-            if j == profile_start:
-                jax.profiler.start_trace(profile_dir)
-                profiling = True
-            elif profiling and j == profile_start + profile_steps:
-                tree_lib.synchronize(task.state.params)
-                jax.profiler.stop_trace()
-                profiling = False
-                logger.info(f"profiler trace written to {profile_dir}")
-        if sched is not None:
-            lr = sched(j * spc)  # optimizer-step units, not loader items
-            if verbose and lr is not None:
-                logger.log({"lr": float(lr)}, j)
-        try:
-            if verbose:
-                logger.info(f"  step {j}: dispatching compiled SPMD step")
-            new_state, metrics = task.step_fn(task.state, batch)
-            task.state = new_state
-        except Exception as e:  # OOM-skip fault tolerance
-            if _is_oom(e):
-                if jax.process_count() > 1:
-                    # Single-host-only semantics, like the reference (skip
-                    # exists in task mode src/ddp_tasks.jl:230-238, NOT in
-                    # process mode src/sync.jl): a one-sided skip would
-                    # desynchronize step counts across hosts and strand
-                    # the others in a collective this host never enters.
-                    raise RuntimeError(
-                        "device OOM on a multi-host run: batch skipping "
-                        "cannot be coordinated one-sidedly — reduce the "
-                        "per-host batch size"
-                    ) from e
-                leaves = jax.tree.leaves(task.state.params)
-                if leaves and getattr(leaves[0], "is_deleted", lambda: False)():
-                    raise RuntimeError(
-                        "device OOM with donate=True: the training state was "
-                        "donated to the failed step and cannot be recovered — "
-                        "re-run prepare_training(donate=False) for OOM-skip"
-                    ) from e
-                task.num_missed += spc
-                logger.info(f"cycle {j}: device OOM — skipping batch ({task.num_missed} missed)")
-                continue
-            raise
-        if eval_every and j % eval_every == 0:
-            if task.val_batch is not None:
-                _eval_and_log(task, task.val_batch, "val", j, topk, logger)
-            # chunked items carry K batches; eval the last sub-batch (the
-            # eval step is compiled for the per-step layout)
-            eb = jax.tree.map(lambda x: x[-1], batch) if spc > 1 else batch
-            _eval_and_log(task, eb, "train", j, topk, logger)
-            loss_m = metrics["loss"]
-            last_loss = loss_m[-1] if getattr(loss_m, "ndim", 0) else loss_m
-            logger.log({"train_step_loss": float(last_loss)}, j)
-        if checkpoint_dir and checkpoint_every and j > 0 and j % checkpoint_every == 0:
-            from .checkpoint import save_checkpoint
+    it = iter(task.loader)
+    _end = object()
+    j = 0
+    done_steps = 0  # optimizer steps that actually ran (skips excluded)
+    try:
+        while True:
+            t_item = time.perf_counter()
+            # data_wait: host time BLOCKED on the prefetch queue — nonzero
+            # percentiles here mean the input pipeline, not the model, is
+            # the bottleneck (the h2d copy itself is timed loader-side)
+            with phases("data_wait"):
+                batch = next(it, _end)
+            if batch is _end:
+                break
+            if print_every and j % print_every == 0:
+                now = time.time()
+                if j > j_mark:
+                    # interval rates; the loop can only run ahead of the device
+                    # by the dispatch queue, so interval averages are accurate
+                    dsteps = (j - j_mark) * spc
+                    dt = max(now - t_mark, 1e-9)
+                    lead = jax.tree.leaves(batch)[0]
+                    gbatch = int(lead.shape[1] if spc > 1 else lead.shape[0])
+                    logger.log(
+                        {
+                            "steps_per_sec": round(dsteps / dt, 3),
+                            "images_per_sec": round(dsteps * gbatch / dt, 1),
+                        },
+                        j,
+                    )
+                    t_mark, j_mark = now, j
+                logger.info(f"cycle {j} (t={now - t_start:.1f}s)")
+                if sink is not None:
+                    sink.write(step=j * spc)
+            if profile_dir is not None:
+                if j == profile_start:
+                    jax.profiler.start_trace(profile_dir)
+                    profiling = True
+                elif profiling and j == profile_start + profile_steps:
+                    tree_lib.synchronize(task.state.params)
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    logger.info(f"profiler trace written to {profile_dir}")
+            if sched is not None:
+                lr = sched(j * spc)  # optimizer-step units, not loader items
+                if verbose and lr is not None:
+                    logger.log({"lr": float(lr)}, j)
+            if (obs.steady_after is not None and not marked_steady
+                    and j >= obs.steady_after):
+                # warmup declared over: any further XLA compile is flagged
+                # as a steady-state recompile (live metric + warning)
+                jaxmon.mark_steady()
+                marked_steady = True
+            skipped = False
+            try:
+                if verbose:
+                    logger.info(f"  step {j}: dispatching compiled SPMD step")
+                # dispatch: host-side time to enqueue the compiled step
+                # (includes any XLA compile on first touch); with
+                # device_sync the separate device phase then holds the
+                # device execution time this step actually took
+                with phases("dispatch"):
+                    new_state, metrics = task.step_fn(task.state, batch)
+                    task.state = new_state
+                if obs.device_sync:
+                    with phases("device"):
+                        jax.block_until_ready(metrics)
+            except Exception as e:  # OOM-skip fault tolerance
+                if _is_oom(e):
+                    if jax.process_count() > 1:
+                        # Single-host-only semantics, like the reference (skip
+                        # exists in task mode src/ddp_tasks.jl:230-238, NOT in
+                        # process mode src/sync.jl): a one-sided skip would
+                        # desynchronize step counts across hosts and strand
+                        # the others in a collective this host never enters.
+                        raise RuntimeError(
+                            "device OOM on a multi-host run: batch skipping "
+                            "cannot be coordinated one-sidedly — reduce the "
+                            "per-host batch size"
+                        ) from e
+                    leaves = jax.tree.leaves(task.state.params)
+                    if leaves and getattr(leaves[0], "is_deleted", lambda: False)():
+                        raise RuntimeError(
+                            "device OOM with donate=True: the training state was "
+                            "donated to the failed step and cannot be recovered — "
+                            "re-run prepare_training(donate=False) for OOM-skip"
+                        ) from e
+                    task.num_missed += spc
+                    oom_total.inc(spc)
+                    logger.info(f"cycle {j}: device OOM — skipping batch ({task.num_missed} missed)")
+                    skipped = True
+                else:
+                    raise
+            # eval and checkpoint are KNOWN-long in-loop work: suspend
+            # stall detection around them (a 2 s checkpoint snapshot in
+            # a 100 ms-step run must not flip /healthz to 503)
+            wd_pause = (obs.watchdog.pause if obs.watchdog is not None
+                        else contextlib.nullcontext)
+            if not skipped:
+                if eval_every and j % eval_every == 0:
+                    with wd_pause(), phases("eval"):
+                        if task.val_batch is not None:
+                            _eval_and_log(task, task.val_batch, "val", j, topk, logger)
+                        # chunked items carry K batches; eval the last sub-batch (the
+                        # eval step is compiled for the per-step layout)
+                        eb = jax.tree.map(lambda x: x[-1], batch) if spc > 1 else batch
+                        _eval_and_log(task, eb, "train", j, topk, logger)
+                        loss_m = metrics["loss"]
+                        last_loss = loss_m[-1] if getattr(loss_m, "ndim", 0) else loss_m
+                        logger.log({"train_step_loss": float(last_loss)}, j)
+                if checkpoint_dir and checkpoint_every and j > 0 and j % checkpoint_every == 0:
+                    from .checkpoint import save_checkpoint
 
-            # async write: the device→host snapshot happens now, the disk
-            # write overlaps subsequent steps (drained before exit below)
-            save_checkpoint(task.state, checkpoint_dir, int(task.state.step), block=False)
+                    # async write: the device→host snapshot happens now, the disk
+                    # write overlaps subsequent steps (drained before exit below)
+                    with wd_pause(), phases("checkpoint"):
+                        save_checkpoint(task.state, checkpoint_dir, int(task.state.step), block=False)
+                steps_total.inc(spc)
+                done_steps += spc
+                step_gauge.set(done_steps)
+                step_hist.observe(time.perf_counter() - t_item)
+            if obs.watchdog is not None:
+                # a skipped batch is still loop progress — the watchdog
+                # hunts wedged loops, not lost work (that's the counter)
+                obs.watchdog.beat()
+            j += 1
+    finally:
+        if obs.watchdog is not None:
+            obs.watchdog.stop()
+        if marked_steady:
+            jaxmon.clear_steady()
+        if obs.tracer is not None and isinstance(task.loader, PrefetchLoader):
+            task.loader.tracer = None
+        if obs.tracer is not None and obs.trace_path:
+            # export even on an exception: the timeline UP TO a crash
+            # is exactly what the postmortem needs
+            n = obs.tracer.export_chrome_trace(obs.trace_path)
+            logger.info(f"span trace ({n} events) written to {obs.trace_path}")
+        if sink is not None:
+            sink.write(step=j * spc, final=True)
 
     if profiling:
         tree_lib.synchronize(task.state.params)
