@@ -167,6 +167,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="declare XLA warmup over after N cycles: any later "
                         "compile is counted + warned as a steady-state "
                         "recompile (fdtpu_jax_steady_recompiles_total)")
+    # cold-start performance (fluxdistributed_tpu.compilation)
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="enable JAX's persistent compilation cache here "
+                        "(topology-namespaced subdir): the next run on "
+                        "the same topology reads its XLA compiles from "
+                        "disk — attempt N+1 of a short TPU grant window "
+                        "skips attempt N's cold start")
+    p.add_argument("--aot", default=None, metavar="DIR",
+                   help="serialized train-step executables: load the "
+                        "compiled step from DIR when topology + argument "
+                        "signature match, else compile at prepare time "
+                        "and serialize for the next process (also skips "
+                        "tracing/lowering, which the compile cache "
+                        "cannot)")
+    p.add_argument("--prewarm", action="store_true",
+                   help="run one donated dummy train step (and eval, "
+                        "when a val set exists) before the training loop "
+                        "starts, so step-0 timing excludes compilation")
     p.add_argument("--watchdog-factor", type=float, default=5.0,
                    help="stall watchdog threshold as a multiple of the "
                         "rolling-median step time (warns + flips /healthz "
@@ -449,6 +467,9 @@ def main(argv=None) -> int:
         spmd=args.spmd,
         zero1=args.zero1,
         steps_per_call=args.steps_per_call,
+        cache_dir=args.compile_cache,
+        aot=args.aot,
+        warmup=args.prewarm,
         **lm_extra,
     )
 

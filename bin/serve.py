@@ -122,6 +122,23 @@ def build_parser():
                    help="match the trainer's --norm")
     p.add_argument("--mlp", default="gelu", choices=["gelu", "swiglu"],
                    help="match the trainer's --mlp")
+    # cold-start controls (fluxdistributed_tpu.compilation)
+    p.add_argument("--prewarm", action="store_true",
+                   help="pre-compile every prefill bucket, the splice "
+                        "and the all-slot decode step BEFORE binding the "
+                        "port — the first request pays decode latency, "
+                        "not the engine's whole compile pool (LM mode)")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="enable JAX's persistent compilation cache here "
+                        "(topology-namespaced): a restarted server reads "
+                        "its XLA compiles from disk instead of redoing "
+                        "them")
+    p.add_argument("--aot-dir", default=None, metavar="DIR",
+                   help="serialized-executable pool for the engine's "
+                        "programs: load from disk when topology+model "
+                        "match, else compile now and serialize for the "
+                        "next process (skips tracing AND compiling on "
+                        "restart; LM mode)")
     return p
 
 
@@ -131,14 +148,19 @@ def make_lm_app(args):
     Separate from HTTP wiring so tests can drive the scheduler directly
     (the ``make_app`` pattern below).
     """
+    import time
+
     import jax
     import numpy as np
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
 
-    from fluxdistributed_tpu import models
+    from fluxdistributed_tpu import compilation, models
     from fluxdistributed_tpu.serve import LMEngine, LMServer, Scheduler
+
+    if args.compile_cache:
+        compilation.enable_persistent_cache(args.compile_cache)
 
     model_fn = getattr(models, args.model, None)
     if model_fn is None or not args.model.startswith("lm_"):
@@ -167,8 +189,13 @@ def make_lm_app(args):
     except ValueError:
         raise SystemExit(f"--buckets must be comma-separated ints, got "
                          f"{args.buckets!r}")
+    t0 = time.perf_counter()
     engine = LMEngine(model, params, max_slots=args.max_slots,
-                      max_len=args.max_len, buckets=buckets)
+                      max_len=args.max_len, buckets=buckets,
+                      prewarm=args.prewarm, aot_dir=args.aot_dir)
+    if args.prewarm or args.aot_dir:
+        print(f"engine ready in {time.perf_counter() - t0:.1f}s "
+              f"(compile_stats={engine.compile_stats()})", file=sys.stderr)
     scheduler = Scheduler(engine, max_queue=args.max_queue)
     return LMServer(scheduler, args.vocab), scheduler
 
@@ -184,6 +211,11 @@ def make_app(args):
 
     from fluxdistributed_tpu import models as models_lib
     from fluxdistributed_tpu.data.preprocess import preprocess
+
+    if args.compile_cache:
+        from fluxdistributed_tpu import compilation
+
+        compilation.enable_persistent_cache(args.compile_cache)
 
     factory = getattr(models_lib, args.model, None)
     if factory is None:

@@ -14,7 +14,18 @@ below are the currently implemented subset.
 """
 
 from . import compat  # noqa: F401 — must precede any jax-surface use
-from . import data, mesh, models, obs, ops, optim, parallel, sharding, tree
+from . import (
+    compilation,
+    data,
+    mesh,
+    models,
+    obs,
+    ops,
+    optim,
+    parallel,
+    sharding,
+    tree,
+)
 
 
 def __getattr__(name):
@@ -52,6 +63,7 @@ from .parallel.dp import flax_loss_fn
 __version__ = "0.1.0"
 
 __all__ = [
+    "compilation",
     "data",
     "mesh",
     "models",

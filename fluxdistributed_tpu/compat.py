@@ -13,6 +13,11 @@ modern names:
   absent, translating ``check_vma=`` to the old ``check_rep=`` spelling.
 * ``tomllib`` — aliased to ``tomli`` in ``sys.modules`` when the stdlib
   module is missing (Python < 3.11), so plain ``import tomllib`` works.
+* :func:`configure_compilation_cache` — the persistent-compilation-
+  cache config knobs (``jax_compilation_cache_dir`` et al.) under their
+  several historical spellings; on a build with none of them the call
+  warns and reports False instead of crashing, so cache enablement is
+  always safe to leave on.
 
 No-ops on a modern toolchain.
 """
@@ -93,6 +98,79 @@ def _install_vma_stubs() -> None:
         jax.typeof = jax.core.get_aval
     if not hasattr(lax, "pcast"):
         lax.pcast = lambda x, axis_name, *, to: x
+
+
+def _try_config_update(name: str, value) -> bool:
+    """``jax.config.update`` that reports instead of raising on a knob
+    this jax build does not define (the error type varies by version:
+    AttributeError on modern builds, KeyError/ValueError historically)."""
+    try:
+        jax.config.update(name, value)
+        return True
+    except (AttributeError, KeyError, ValueError, TypeError):
+        return False
+
+
+def configure_compilation_cache(
+    cache_dir: str,
+    *,
+    min_entry_size_bytes=None,
+    min_compile_time_secs=None,
+) -> bool:
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    Tries the config-option spelling first (``jax_compilation_cache_dir``
+    — jax >= 0.4.x), then the ``compilation_cache.set_cache_dir`` API of
+    older builds.  The threshold knobs
+    (``jax_persistent_cache_min_entry_size_bytes`` /
+    ``jax_persistent_cache_min_compile_time_secs``) are best-effort: a
+    build without them keeps its defaults silently — they tune WHAT gets
+    cached, not whether caching works.
+
+    Returns True when a cache directory was installed by either path;
+    False (after a one-line warning) when this jax has no persistent
+    cache at all — callers treat that as "enablement is a no-op", never
+    an error.
+    """
+    installed = _try_config_update("jax_compilation_cache_dir", cache_dir)
+    if not installed:
+        try:  # pre-config-option spelling
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc,
+            )
+
+            _cc.set_cache_dir(cache_dir)  # type: ignore[attr-defined]
+            installed = True
+        except Exception:  # noqa: BLE001 — absence, not failure
+            installed = False
+    if not installed:
+        import warnings
+
+        warnings.warn(
+            "this jax build has no persistent compilation cache "
+            "(jax_compilation_cache_dir / compilation_cache.set_cache_dir "
+            "both absent); cold-start caching is disabled",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return False
+    if min_entry_size_bytes is not None:
+        _try_config_update(
+            "jax_persistent_cache_min_entry_size_bytes", min_entry_size_bytes)
+    if min_compile_time_secs is not None:
+        _try_config_update(
+            "jax_persistent_cache_min_compile_time_secs", min_compile_time_secs)
+    # jax decides once per process whether the cache is usable and then
+    # memoizes the answer; clear that memo so enabling the cache AFTER
+    # an early compile (a REPL, a test that ran first) still takes
+    # effect for every later compile
+    try:
+        from jax._src import compilation_cache as _icc
+
+        _icc.reset_cache()
+    except Exception:  # noqa: BLE001 — older layouts; memo just stays
+        pass
+    return True
 
 
 def _install_tomllib() -> None:

@@ -1,0 +1,427 @@
+"""Cold-start performance subsystem: persistent compile cache + AOT
+executables + warmup.
+
+Every hardware benchmark round to date (BENCH_r01-r05) died inside XLA
+cold-start compilation: the measurement itself takes seconds, but the
+first process to touch the chip pays minutes of compilation before a
+single step runs, and short TPU grant windows expire first.  The
+compile-once/execute-many XLA contract (arXiv:1810.09868) means none of
+that work is inherently per-process — this module makes it durable:
+
+* :func:`enable_persistent_cache` — one call turns on JAX's persistent
+  compilation cache (disk-backed, content-addressed by HLO + compile
+  options), namespaced per topology so a CPU dev box and a TPU slice
+  never collide in one directory.  Config-name differences across jax
+  versions are absorbed by :func:`compat.configure_compilation_cache`
+  (no-op with a warning, never a crash, on builds without the knobs).
+* AOT helpers — :func:`aot_compile` (``lower → compile``),
+  :func:`save_executable` / :func:`load_executable` (serialize the
+  compiled XLA executable itself to disk, fingerprint-stamped), and
+  :func:`load_or_compile` which falls back to a fresh compile whenever
+  the topology/jaxlib fingerprint or argument signature mismatches.
+  Where the persistent cache skips the *backend compile*, a serialized
+  executable also skips tracing and lowering — the whole cold path.
+* :func:`warmup_train` — run ONE donated dummy train step (fresh
+  zero-filled buffers, the live state untouched) so every compile and
+  allocator warm-up is paid before timing or traffic starts.  The serve
+  side's analog is :meth:`LMEngine.warmup`.
+
+Everything reports through the obs registry: AOT loads/compiles are
+counters (``fdtpu_aot_loads_total`` / ``fdtpu_aot_compiles_total``) and
+the cache's own hit/miss stream lands via :mod:`obs.jaxmon`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import re
+import time
+from typing import Any, Optional, Sequence
+
+from . import compat
+
+__all__ = [
+    "enable_persistent_cache",
+    "persistent_cache_dir",
+    "topology_fingerprint",
+    "topology_namespace",
+    "abstract_signature",
+    "callable_tag",
+    "config_tag",
+    "aot_compile",
+    "save_executable",
+    "load_executable",
+    "load_or_compile",
+    "warmup_train",
+    "compile_metrics",
+]
+
+#: format tag embedded in every serialized executable; bumping it
+#: invalidates all on-disk executables at once (they fall back to a
+#: fresh compile, never to a crash)
+AOT_MAGIC = "fdtpu-aot-v1"
+
+#: filename suffix for serialized executables
+AOT_SUFFIX = ".jaxexec"
+
+_cache_dir: Optional[str] = None
+
+
+def topology_fingerprint(mesh=None, tag: str = "") -> str:
+    """Digest of everything a serialized executable is specific to:
+    jax/jaxlib versions, backend platform and device kind, device and
+    process counts, optionally the mesh shape and a caller tag (e.g.
+    the spmd mode knobs that change the compiled program without
+    changing argument shapes).  Argument SHAPES are deliberately not
+    here — :func:`abstract_signature` covers those, so the two compose
+    into the on-disk key."""
+    import jax
+    import jaxlib
+
+    dev = jax.devices()[0]
+    parts = [
+        jax.__version__,
+        jaxlib.__version__,
+        dev.platform,
+        str(getattr(dev, "device_kind", "")),
+        str(jax.device_count()),
+        str(jax.process_count()),
+    ]
+    if mesh is not None:
+        parts.append(repr(sorted(dict(mesh.shape).items())))
+    if tag:
+        parts.append(tag)
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+
+def topology_namespace() -> str:
+    """Human-readable per-topology subdirectory for the persistent
+    cache: ``tpu-tpu-v5-lite-d8p1-jax0.4.37``.  jax's own cache key
+    already covers all of this — the namespace exists so one shared
+    cache root stays inspectable (which entries belong to which
+    machine) and so an rsync of one topology's entries is possible."""
+    import jax
+    import jaxlib
+
+    dev = jax.devices()[0]
+    kind = re.sub(r"[^a-z0-9]+", "-", str(
+        getattr(dev, "device_kind", "") or dev.platform).lower()).strip("-")
+    return (f"{dev.platform}-{kind}-d{jax.device_count()}"
+            f"p{jax.process_count()}-jax{jax.__version__}-{jaxlib.__version__}")
+
+
+def enable_persistent_cache(
+    cache_dir: Optional[str],
+    *,
+    min_entry_size_bytes: int = -1,
+    min_compile_time_secs: float = 0.0,
+    namespace: bool = True,
+) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Returns the RESOLVED directory (namespaced per topology unless
+    ``namespace=False``), or ``None`` when ``cache_dir`` is falsy or
+    this jax build has no persistent cache (warned, never raised —
+    the compat shim).  Thresholds default to "cache everything":
+    ``min_entry_size_bytes=-1`` (jax's use-min-compile-time sentinel)
+    and ``min_compile_time_secs=0.0`` — on TPU the compiles that matter
+    are all multi-second, and on CPU (tests, smoke runs) the point is
+    exactly the small entries jax's 1s default would skip.
+
+    Call it BEFORE the first compile; when something already compiled,
+    the enablement still takes effect for later compiles (jax's
+    once-per-task cache-usage check is reset).
+    """
+    global _cache_dir
+    if not cache_dir:
+        return None
+    path = os.path.abspath(os.path.expanduser(cache_dir))
+    if namespace:
+        path = os.path.join(path, topology_namespace())
+    os.makedirs(path, exist_ok=True)
+    if not compat.configure_compilation_cache(
+            path, min_entry_size_bytes=min_entry_size_bytes,
+            min_compile_time_secs=min_compile_time_secs):
+        return None
+    _cache_dir = path
+    # surface enablement in the registry: a scrape answers "is this
+    # process even using the cache" without reading logs
+    from .obs import get_registry, jaxmon
+
+    jaxmon.install()
+    get_registry().gauge(
+        "fdtpu_compile_cache_enabled",
+        "1 when the persistent XLA compilation cache is configured",
+    ).set(1)
+    return path
+
+
+def persistent_cache_dir() -> Optional[str]:
+    """The resolved cache directory of the last successful
+    :func:`enable_persistent_cache` call in this process (None when the
+    cache was never enabled here)."""
+    return _cache_dir
+
+
+def abstract_signature(args: Sequence[Any], kwargs: Optional[dict] = None) -> str:
+    """Digest of the tree structure + shapes/dtypes of a call's
+    arguments — the part of an executable's identity the topology
+    fingerprint does not cover.  Two calls with the same signature and
+    fingerprint may share a serialized executable; anything else must
+    not."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten((tuple(args), kwargs or {}))
+
+    def aval(x):
+        shape = tuple(getattr(x, "shape", ()))
+        dtype = str(getattr(x, "dtype", type(x).__name__))
+        return f"{shape}:{dtype}"
+
+    payload = str(treedef) + "|" + ";".join(aval(x) for x in leaves)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def callable_tag(fn, depth: int = 2) -> str:
+    """Stable identity string for a configured callable: its name plus
+    any scalar constants (and, one level down, callables) closed over —
+    e.g. ``momentum(0.1, 0.9).update`` → ``update:0.1:0.9``.  This is
+    what distinguishes two optimizers/losses whose hyperparameters are
+    baked into the compiled program as constants without changing any
+    argument shape.  Deliberately address-free: reprs of functions or
+    objects (which embed ``0x...`` ids) never enter the tag, so the
+    same configuration hashes identically across processes."""
+    parts = [getattr(fn, "__name__", type(fn).__name__)]
+    for cell in getattr(fn, "__closure__", None) or ():
+        try:
+            v = cell.cell_contents
+        except ValueError:  # pragma: no cover — empty cell
+            continue
+        if isinstance(v, (bool, int, float, str, bytes, type(None))):
+            parts.append(repr(v))
+        elif isinstance(v, (tuple, frozenset)) and all(
+                isinstance(e, (bool, int, float, str)) for e in v):
+            parts.append(repr(v))
+        elif callable(v) and depth > 0:
+            parts.append(callable_tag(v, depth - 1))
+    return ":".join(parts)
+
+
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+
+
+def config_tag(*parts) -> str:
+    """Digest arbitrary configuration parts into the short tag that
+    feeds :func:`topology_fingerprint` — THE one place AOT key
+    construction lives, shared by the trainer and the serve engine so
+    the two cannot drift.  Callables route through :func:`callable_tag`;
+    everything else stringifies with memory addresses scrubbed — a
+    ``repr(model)`` whose ``attn_fn`` field prints ``<function ... at
+    0x7f...>`` must hash identically across processes or on-disk
+    executables are never reused."""
+    norm = []
+    for p in parts:
+        if callable(p) and not isinstance(p, type):
+            norm.append(callable_tag(p))
+        else:
+            norm.append(_ADDR_RE.sub("0x", str(p)))
+    return hashlib.sha256("|".join(norm).encode()).hexdigest()[:12]
+
+
+def aot_compile(fn, *args, **kwargs):
+    """``lower → compile`` of a jitted callable at the given (concrete
+    or ShapeDtypeStruct) arguments.  The result executes those argument
+    shapes only — that is the point: it can be serialized."""
+    if not hasattr(fn, "lower"):
+        raise ValueError(
+            f"{getattr(fn, '__name__', fn)!r} has no .lower — AOT "
+            "compilation needs a jax.jit-wrapped callable")
+    return fn.lower(*args, **kwargs).compile()
+
+
+def save_executable(path: str, compiled, *, fingerprint: Optional[str] = None) -> str:
+    """Serialize an AOT-compiled executable to ``path`` (atomic write).
+    The file carries a format magic and the topology fingerprint;
+    :func:`load_executable` refuses anything that does not match."""
+    from jax.experimental.serialize_executable import serialize
+
+    payload, in_tree, out_tree = serialize(compiled)
+    blob = pickle.dumps({
+        "magic": AOT_MAGIC,
+        "fingerprint": fingerprint or topology_fingerprint(),
+        "payload": payload,
+        "in_tree": in_tree,
+        "out_tree": out_tree,
+    })
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    return path
+
+
+def load_executable(path: str, *, fingerprint: Optional[str] = None):
+    """Deserialize an executable saved by :func:`save_executable`.
+
+    Returns ``None`` — never raises — on a missing/corrupt file, a
+    format-magic mismatch, or a topology fingerprint mismatch: every
+    load site falls back to a fresh compile, so a stale artifact can
+    only ever cost the compile it failed to save."""
+    from jax.experimental.serialize_executable import deserialize_and_load
+
+    expected = fingerprint or topology_fingerprint()
+    try:
+        with open(path, "rb") as f:
+            blob = pickle.loads(f.read())
+        if blob.get("magic") != AOT_MAGIC or blob.get("fingerprint") != expected:
+            return None
+        return deserialize_and_load(
+            blob["payload"], blob["in_tree"], blob["out_tree"])
+    except Exception:  # noqa: BLE001 — any load failure means "recompile"
+        return None
+
+
+def load_or_compile(
+    fn,
+    args: Sequence[Any] = (),
+    kwargs: Optional[dict] = None,
+    *,
+    directory: str,
+    name: str,
+    fingerprint: Optional[str] = None,
+    save: bool = True,
+    registry=None,
+):
+    """The AOT workflow in one call: look for a serialized executable of
+    ``fn`` at these arguments under ``directory``, else lower + compile
+    (and serialize the result for the next process).
+
+    The on-disk key is ``<name>-<topology fp>-<argument signature>`` —
+    a jaxlib upgrade, a different device count, or a shape change each
+    select a different file, so a mismatch is an automatic miss, not a
+    crash.  Outcomes are counted in the obs registry
+    (``fdtpu_aot_loads_total`` / ``fdtpu_aot_compiles_total``) and the
+    load/compile seconds accumulate in
+    ``fdtpu_aot_seconds_total{source=...}``.
+    """
+    from .obs import get_registry
+
+    reg = registry or get_registry()
+    fp = fingerprint or topology_fingerprint()
+    sig = abstract_signature(args, kwargs)
+    path = os.path.join(directory, f"{name}-{fp}-{sig}{AOT_SUFFIX}")
+    secs = reg.histogram(
+        "fdtpu_aot_seconds_total",
+        "wall seconds loading or compiling AOT executables",
+        labelnames=("source",),
+    )
+    t0 = time.perf_counter()
+    compiled = load_executable(path, fingerprint=fp)
+    if compiled is not None:
+        reg.counter(
+            "fdtpu_aot_loads_total",
+            "AOT executables deserialized from disk (compile skipped)",
+        ).inc()
+        secs.labels(source="load").observe(time.perf_counter() - t0)
+        return compiled
+    t0 = time.perf_counter()
+    compiled = aot_compile(fn, *args, **(kwargs or {}))
+    reg.counter(
+        "fdtpu_aot_compiles_total",
+        "AOT executables compiled fresh (no matching serialized file)",
+    ).inc()
+    secs.labels(source="compile").observe(time.perf_counter() - t0)
+    if save:
+        try:
+            save_executable(path, compiled, fingerprint=fp)
+        except Exception as e:  # noqa: BLE001 — serialization is best-effort
+            import sys
+
+            print(f"compilation: could not serialize {name!r} to {path}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+    return compiled
+
+
+def _sharded_zeros_like(tree):
+    """Fresh zero-filled buffers with the SAME shardings as ``tree``,
+    assembled shard-by-shard: a model whose state only fits sharded
+    never materializes a dense copy on one device, and mixed device
+    sets across leaves (a replicated param tree next to a
+    single-device step counter) are fine — each leaf is built
+    independently."""
+    import jax
+    import numpy as np
+
+    def shard_shape(shape, idx):
+        out = list(shape)
+        for d, sl in enumerate(idx):
+            start, stop, _ = sl.indices(shape[d])
+            out[d] = max(0, stop - start)
+        return tuple(out)
+
+    def zeros(x):
+        if not isinstance(x, jax.Array):
+            return x
+        return jax.make_array_from_callback(
+            x.shape, x.sharding,
+            lambda idx: np.zeros(shard_shape(x.shape, idx), dtype=x.dtype))
+
+    return jax.tree.map(zeros, tree)
+
+
+def warmup_train(task, batch, *, eval_too: bool = True) -> dict:
+    """Pre-pay the training cold start: run ONE optimizer step on
+    donated dummy inputs (zero-filled copies with the live state's
+    shardings — the real :class:`TrainState` is never touched, so this
+    composes with ``donate=True`` steps) and block until it lands.
+
+    ``batch`` must have the exact layout training will feed (the
+    trainer's ``prepare_training(warmup=True)`` builds it from the
+    dataset).  With ``eval_too`` the compiled eval step warms up
+    against the task's val batch when one exists.
+
+    Returns ``{"seconds": ..., "compiles": ..., "compile_seconds": ...}``
+    — what the cold start actually cost, so callers can log it against
+    the steps it saves.
+    """
+    import jax
+
+    from .obs import jaxmon
+
+    jaxmon.install()
+    c0, s0 = jaxmon.compile_count(), jaxmon.compile_seconds()
+    t0 = time.perf_counter()
+    dummy_state = _sharded_zeros_like(task.state)
+    out = task.step_fn(dummy_state, batch)
+    jax.block_until_ready(jax.tree.leaves(out))
+    if eval_too and task.val_batch is not None:
+        # the dummy state was (possibly) donated to the step above —
+        # eval gets its own fresh zeros
+        ev = task.eval_fn(_sharded_zeros_like(task.state), task.val_batch)
+        jax.block_until_ready(jax.tree.leaves(ev))
+    return {
+        "seconds": time.perf_counter() - t0,
+        "compiles": jaxmon.compile_count() - c0,
+        "compile_seconds": jaxmon.compile_seconds() - s0,
+    }
+
+
+def compile_metrics() -> dict:
+    """The cold-start ledger of this process, from the jaxmon counters:
+    compile count/seconds plus persistent-cache hits/misses and the
+    compile seconds the cache saved.  The bench harness embeds this in
+    its JSON line (success AND timeout paths) so a dead round says
+    whether the time went to compilation or to the hardware."""
+    from .obs import jaxmon
+
+    jaxmon.install()
+    return {
+        "compiles": int(jaxmon.compile_count()),
+        "compile_seconds": round(jaxmon.compile_seconds(), 3),
+        "cache_hits": int(jaxmon.cache_hits()),
+        "cache_misses": int(jaxmon.cache_misses()),
+        "compile_seconds_saved": round(jaxmon.compile_seconds_saved(), 3),
+    }

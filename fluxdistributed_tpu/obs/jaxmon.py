@@ -16,6 +16,13 @@ consumes); this module folds them into the metrics registry:
   the caller declared steady state.  The serve engine's "ONE decode
   compile" invariant (tests assert it offline) becomes a live metric:
   scrape nonzero here in production and something is recompiling.
+* ``fdtpu_jax_cache_hits_total`` / ``fdtpu_jax_cache_misses_total`` /
+  ``fdtpu_jax_cache_saved_seconds_total`` — the persistent compilation
+  cache's own event stream (``/jax/compilation_cache/*``).  NOTE: a
+  persistent-cache HIT still records a ``backend_compile_duration``
+  event on this jax (the timer brackets compile-or-load), so "zero new
+  compiles" is asserted as ``cache_misses == 0``, not as a zero compile
+  counter.
 
 Install is idempotent and process-global (JAX offers registration but
 no deregistration); the listener holds only module state and costs one
@@ -38,11 +45,18 @@ __all__ = [
     "clear_steady",
     "steady_state",
     "compile_count",
+    "compile_seconds",
+    "cache_hits",
+    "cache_misses",
+    "compile_seconds_saved",
     "steady_recompiles",
 ]
 
 BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+CACHE_SAVED_EVENT = "/jax/compilation_cache/compile_time_saved_sec"
 
 _lock = threading.Lock()
 _installed = False
@@ -77,6 +91,29 @@ def _listener(event: str, duration: float, **kwargs) -> None:
         reg.counter(
             "fdtpu_jax_trace_seconds_total", "jaxpr trace seconds"
         ).inc(duration)
+    elif event == CACHE_SAVED_EVENT:
+        reg.counter(
+            "fdtpu_jax_cache_saved_seconds_total",
+            "compile wall seconds skipped by persistent-cache hits",
+        ).inc(max(duration, 0.0))
+
+
+def _event_listener(event: str, **kwargs) -> None:
+    """Plain (non-duration) monitoring events: the persistent
+    compilation cache's hit/miss stream."""
+    reg = _registry
+    if reg is None:  # pragma: no cover — install() always binds one
+        return
+    if event == CACHE_HIT_EVENT:
+        reg.counter(
+            "fdtpu_jax_cache_hits_total",
+            "XLA compiles served from the persistent compilation cache",
+        ).inc()
+    elif event == CACHE_MISS_EVENT:
+        reg.counter(
+            "fdtpu_jax_cache_misses_total",
+            "XLA compiles the persistent compilation cache could not serve",
+        ).inc()
 
 
 def install(registry: Optional[Registry] = None,
@@ -107,7 +144,20 @@ def install(registry: Optional[Registry] = None,
             "compiles observed AFTER steady state was declared "
             "(any nonzero value means something is recompiling)",
         )
+        _registry.counter(
+            "fdtpu_jax_cache_hits_total",
+            "XLA compiles served from the persistent compilation cache",
+        )
+        _registry.counter(
+            "fdtpu_jax_cache_misses_total",
+            "XLA compiles the persistent compilation cache could not serve",
+        )
+        _registry.counter(
+            "fdtpu_jax_cache_saved_seconds_total",
+            "compile wall seconds skipped by persistent-cache hits",
+        )
         jax.monitoring.register_event_duration_secs_listener(_listener)
+        jax.monitoring.register_event_listener(_event_listener)
         _installed = True
 
 
@@ -145,6 +195,26 @@ def steady_state():
 def compile_count() -> float:
     reg = _registry or get_registry()
     return reg.value("fdtpu_jax_compiles_total")
+
+
+def compile_seconds() -> float:
+    reg = _registry or get_registry()
+    return reg.value("fdtpu_jax_compile_seconds_total")
+
+
+def cache_hits() -> float:
+    reg = _registry or get_registry()
+    return reg.value("fdtpu_jax_cache_hits_total")
+
+
+def cache_misses() -> float:
+    reg = _registry or get_registry()
+    return reg.value("fdtpu_jax_cache_misses_total")
+
+
+def compile_seconds_saved() -> float:
+    reg = _registry or get_registry()
+    return reg.value("fdtpu_jax_cache_saved_seconds_total")
 
 
 def steady_recompiles() -> float:

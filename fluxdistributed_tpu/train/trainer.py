@@ -125,6 +125,9 @@ def prepare_training(
     steps_per_call: int = 1,
     num_microbatches: Optional[int] = None,
     pipeline_interleave: bool = False,
+    cache_dir: Optional[str] = None,
+    aot: Optional[str] = None,
+    warmup: bool = False,
 ) -> TrainTask:
     """Initialize params, compile the SPMD step, build prefetch loaders.
 
@@ -175,8 +178,31 @@ def prepare_training(
     runtime sits behind a network tunnel or the host is slow; cadences
     in ``train`` (print/eval/checkpoint) then tick once per K steps.
     Supported for ``spmd='jit'``.
+
+    Cold-start controls (:mod:`fluxdistributed_tpu.compilation`):
+
+    * ``cache_dir`` enables JAX's persistent compilation cache there
+      (namespaced per topology) BEFORE any compile in this call, so the
+      next process on the same topology reads every XLA compile from
+      disk instead of redoing it.
+    * ``aot`` names a directory of serialized train-step executables:
+      the compiled step is loaded from disk when a file matching this
+      topology + argument signature exists, else compiled NOW (at
+      prepare time, not at first step) and serialized for the next
+      process.  Unlike the persistent cache, a serialized executable
+      also skips tracing and lowering.  Requires a jit-compiled step
+      (every current spmd mode qualifies).
+    * ``warmup=True`` runs one optimizer step on donated zero-filled
+      dummies (the returned task's real state is untouched) before
+      returning, so the first ``train`` step — and anything timing it —
+      starts warm.
     """
     from ..data.loader import apply_transform
+
+    if cache_dir:
+        from .. import compilation
+
+        compilation.enable_persistent_cache(cache_dir)
 
     if spmd == "dp":  # explicit-name alias for the auto-sharded DP path
         spmd = "jit"
@@ -539,7 +565,7 @@ def prepare_training(
             batch_to_dict(vdraw, getattr(val_dataset, "nclasses", None)), mesh
         )
 
-    return TrainTask(
+    task = TrainTask(
         state=state,
         step_fn=step_fn,
         eval_fn=eval_fn,
@@ -553,6 +579,80 @@ def prepare_training(
         batch_quantum=batch_quantum,
         topk=tuple(topk),
     )
+
+    if aot or warmup:
+        from .. import compilation
+
+        dummy = _dummy_batch(
+            dataset, transform, batch_size, mesh, steps_per_call, seed)
+        if aot:
+            # the tag covers everything that changes the compiled
+            # program WITHOUT changing argument shapes: mode/schedule
+            # knobs, model hyperparameters like attention windows, and
+            # the optimizer/loss with their closed-over hyperparameters
+            # (a different learning rate bakes different constants into
+            # the same-shaped program — config_tag digests callables by
+            # name + closure constants, address-free).  Argument
+            # shapes/shardings are the signature's job inside
+            # load_or_compile
+            tag = compilation.config_tag(
+                spmd, zero1, accum_steps, steps_per_call, donate, seed,
+                num_microbatches, pipeline_interleave, repr(model),
+                optimizer.name, optimizer.update, loss_fn, loss)
+            task.step_fn = compilation.load_or_compile(
+                task.step_fn, (task.state, dummy),
+                directory=aot, name="train_step",
+                fingerprint=compilation.topology_fingerprint(
+                    mesh=mesh, tag=tag),
+            )
+            # an AOT executable (unlike jit) does NOT reshard inputs:
+            # commit the state to the exact shardings it was compiled
+            # with (no-op transfers for already-matching leaves; the
+            # step's output shardings keep the loop consistent after)
+            in_sh = getattr(task.step_fn, "input_shardings", None)
+            if in_sh is not None:
+                task.state = jax.tree.map(
+                    jax.device_put, task.state, in_sh[0][0])
+        if warmup:
+            stats = compilation.warmup_train(task, dummy)
+            current_logger().info(
+                f"warmup: {int(stats['compiles'])} compiles "
+                f"({stats['compile_seconds']:.1f}s of "
+                f"{stats['seconds']:.1f}s) pre-paid before step 0")
+
+    return task
+
+
+def _dummy_batch(dataset, transform, batch_size, mesh, steps_per_call, seed):
+    """One batch with training's exact layout (transform applied,
+    device-sharded, stacked when the device loop is on) for AOT
+    lowering and warmup — drawn from the dataset so shapes AND dtypes
+    are the real ones, discarded after use."""
+    from ..data.loader import apply_transform, batch_to_dict
+
+    draw = apply_transform(
+        transform, dataset.batch(np.random.default_rng(seed + 2), batch_size))
+    bd = batch_to_dict(draw, getattr(dataset, "nclasses", None))
+    if steps_per_call > 1:
+        # the loader's chunk layout: K stacked per-step batches sharded
+        # P(None, data) — leading dim is the scan axis, not the batch.
+        # Routed through the canonical local-rows→global-array boundary
+        # (batch_dim=1, like the loader) so multi-process warmup works
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..parallel.multihost import global_batch_put, local_batch_size
+
+        s = NamedSharding(mesh, PartitionSpec(None, mesh_lib.DATA_AXIS))
+        pi = jax.process_index()
+
+        def put(v):
+            rows = local_batch_size(v.shape[0])
+            local = np.asarray(v[pi * rows:(pi + 1) * rows])
+            return global_batch_put(
+                np.stack([local] * steps_per_call), s, batch_dim=1)
+
+        return {k: put(v) for k, v in bd.items()}
+    return sharding_lib.shard_batch(bd, mesh)
 
 
 def restore_training(

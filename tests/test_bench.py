@@ -99,6 +99,12 @@ def test_main_emits_error_json_and_rc0_on_failure(bench_mod, monkeypatch, capsys
     out = json.loads(line)
     assert out["unit"] == "images/sec/chip"
     assert "timed out" in out["error"]
+    # the cold-start ledger rides the ERROR json too, so a timed-out
+    # round says whether the window went to compilation or the hardware
+    # (no child ran here, so the forensic defaults apply)
+    assert out["phase"] == "unknown"
+    assert out["compile_seconds"] == 0.0
+    assert out["cache_hits"] == 0 and out["cache_misses"] == 0
 
     class FakeDone:
         returncode = 1
@@ -120,3 +126,29 @@ def test_main_emits_error_json_and_rc0_on_failure(bench_mod, monkeypatch, capsys
     bench_mod.main()
     line = capsys.readouterr().out.strip().splitlines()[-1]
     assert json.loads(line)["value"] == 1.0
+
+
+def test_status_file_snapshots_phase_and_compile_ledger(bench_mod, tmp_path):
+    """The bounded subprocess drops phase + compile-counter snapshots;
+    main() folds the last one into the error JSON on a dead attempt."""
+    path = str(tmp_path / "status.json")
+    bench_mod._write_status(path, "compile")
+    snap = json.loads(open(path).read())
+    assert snap["phase"] == "compile"
+    for key in ("compile_seconds", "cache_hits", "cache_misses"):
+        assert key in snap
+    bench_mod._write_status(None, "ignored")  # disabled path: no raise
+
+
+def test_default_cache_dir_env_override(bench_mod, monkeypatch):
+    """FDTPU_COMPILE_CACHE_DIR overrides the benchmarks/hw default;
+    empty string disables caching entirely."""
+    import os
+
+    monkeypatch.delenv("FDTPU_COMPILE_CACHE_DIR", raising=False)
+    assert bench_mod.default_cache_dir().endswith(
+        os.path.join("benchmarks", "hw", "xla_cache"))
+    monkeypatch.setenv("FDTPU_COMPILE_CACHE_DIR", "/somewhere/else")
+    assert bench_mod.default_cache_dir() == "/somewhere/else"
+    monkeypatch.setenv("FDTPU_COMPILE_CACHE_DIR", "")
+    assert bench_mod.default_cache_dir() is None

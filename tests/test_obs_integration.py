@@ -115,10 +115,13 @@ def _driver_env():
 
 @pytest.mark.slow
 def test_driver_obs_flags_end_to_end(tmp_path):
-    """--trace-events/--metrics-jsonl/--steady-after through the CLI:
-    artifacts appear and the run completes."""
+    """--trace-events/--metrics-jsonl/--steady-after plus the cold-start
+    flags (--compile-cache/--aot/--prewarm) through the CLI: artifacts
+    appear and the run completes."""
     trace = tmp_path / "driver.trace.json"
     jsonl = tmp_path / "driver.jsonl"
+    cache = tmp_path / "compile-cache"
+    aot = tmp_path / "aot"
     out = subprocess.run(
         [sys.executable, os.path.join("bin", "driver.py"),
          "--model", "SimpleCNN", "--dataset", "synthetic",
@@ -127,6 +130,7 @@ def test_driver_obs_flags_end_to_end(tmp_path):
          "--print-every", "1", "--eval-every", "0",
          "--trace-events", str(trace), "--metrics-jsonl", str(jsonl),
          "--steady-after", "3",
+         "--compile-cache", str(cache), "--aot", str(aot), "--prewarm",
          "--platform", "cpu", "--local-devices", "8"],
         capture_output=True, text=True, timeout=600, env=_driver_env(),
         cwd=str(REPO),
@@ -138,6 +142,13 @@ def test_driver_obs_flags_end_to_end(tmp_path):
         e["name"] for e in doc["traceEvents"]}
     lines = [json.loads(l) for l in jsonl.read_text().splitlines()]
     assert lines[-1]["metrics"]["fdtpu_train_steps_total"] == 4
+    # cold-start artifacts: a topology-namespaced populated cache dir
+    # and one serialized train-step executable
+    (ns,) = os.listdir(cache)
+    assert os.listdir(cache / ns), "compile cache stayed empty"
+    assert any(f.startswith("train_step-") for f in os.listdir(aot))
+    # --prewarm declared its cost before step 0
+    assert "warmup:" in out.stdout, out.stdout[-2000:]
 
 
 @pytest.mark.slow
