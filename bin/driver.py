@@ -185,6 +185,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run one donated dummy train step (and eval, "
                         "when a val set exists) before the training loop "
                         "starts, so step-0 timing excludes compilation")
+    p.add_argument("--strict-checks", action="store_true",
+                   help="debug-grade first steps: call 1 runs under "
+                        "jax_debug_nans (a NaN names its producing "
+                        "primitive), call 2 under "
+                        "jax.transfer_guard('disallow') (an implicit "
+                        "host<->device transfer on the steady state "
+                        "raises); failures name the offending phase")
     p.add_argument("--watchdog-factor", type=float, default=5.0,
                    help="stall watchdog threshold as a multiple of the "
                         "rolling-median step time (warns + flips /healthz "
@@ -470,6 +477,7 @@ def main(argv=None) -> int:
         cache_dir=args.compile_cache,
         aot=args.aot,
         warmup=args.prewarm,
+        strict_checks=args.strict_checks,
         **lm_extra,
     )
 

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""fdtpu-lint CLI — the repo's JAX-hazard static-analysis gate.
+
+    # full suite (AST rules + jaxpr-layer variant checks), baseline-aware:
+    python bin/lint.py --check
+
+    # CI invocation (fails on any finding not in the checked-in baseline):
+    python bin/lint.py --check --baseline fluxdistributed_tpu/analysis/baseline.json
+
+    # lint specific files/dirs (AST layer only):
+    python bin/lint.py tests/fixtures_analysis/fdt101_pos.py
+
+    # accept the current findings as the new allowlist:
+    python bin/lint.py --update-baseline
+
+Exit codes: 0 = clean (or informational run), 1 = new findings under
+``--check`` (each printed as ``file:line: severity [RULE] message``),
+2 = usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _bootstrap() -> None:
+    """Make the package importable when run as ``python bin/lint.py``
+    from a checkout (no install, no PYTHONPATH)."""
+    try:
+        import fluxdistributed_tpu  # noqa: F401
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to AST-scan (default: the package, "
+                        "bin/ and bench.py; passing explicit paths skips "
+                        "the jaxpr layer unless --jaxpr)")
+    p.add_argument("--check", action="store_true",
+                   help="fail (exit 1) on findings not in the baseline")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON path (default: "
+                        "fluxdistributed_tpu/analysis/baseline.json)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="write the current findings to the baseline and exit")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit findings + summary as one JSON object")
+    p.add_argument("--no-jaxpr", action="store_true",
+                   help="skip the jaxpr layer (AST rules only — no jax "
+                        "import, milliseconds)")
+    p.add_argument("--jaxpr", action="store_true",
+                   help="run the jaxpr layer even when explicit paths "
+                        "are given")
+    p.add_argument("--variants", default=None,
+                   help="comma-separated jaxpr variants to check "
+                        "(default: all registered — dp,zero1,fsdp,tp,"
+                        "pp_1f1b,context,serve)")
+    p.add_argument("--execute", action="store_true",
+                   help="also run one real step per variant under "
+                        "jax.transfer_guard('disallow') (compiles; "
+                        "default only for the variants marked cheap)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    _bootstrap()
+    from fluxdistributed_tpu import analysis
+
+    baseline_path = args.baseline or analysis.default_baseline_path()
+    if args.baseline and not os.path.exists(baseline_path):
+        # a mistyped --baseline must not silently become "empty
+        # allowlist, everything is new"
+        alt = os.path.join(analysis.repo_root(), args.baseline)
+        if os.path.exists(alt):
+            baseline_path = alt
+        elif args.check:
+            print(f"lint: baseline {args.baseline} not found", file=sys.stderr)
+            return 2
+
+    findings = (analysis.scan_paths(args.paths) if args.paths
+                else analysis.scan_repo())
+
+    run_jaxpr = (args.jaxpr or not args.paths) and not args.no_jaxpr
+    if run_jaxpr:
+        # the 8-virtual-device mesh must be pinned before jax touches a
+        # backend; force_host_devices also wins over an env-pinned platform
+        from fluxdistributed_tpu.mesh import force_host_devices
+
+        force_host_devices(8)
+        from fluxdistributed_tpu.analysis import jaxpr_checks
+
+        names = args.variants.split(",") if args.variants else None
+        findings += jaxpr_checks.run_jaxpr_checks(
+            names=names, execute=True if args.execute else None)
+
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+
+    if args.update_baseline:
+        # a partial-scope run (explicit paths / --no-jaxpr) must not
+        # erase allowlist entries it could not have re-observed: keep
+        # AST entries for unscanned files, and jaxpr-layer (FDT2xx)
+        # entries whenever the jaxpr layer did not run
+        scanned = set(analysis.scanned_files(args.paths or None))
+        keep = [
+            e for e in analysis.load_baseline(baseline_path)
+            if (e.get("file") not in scanned
+                if not e.get("rule", "").startswith("FDT2")
+                else not run_jaxpr)
+        ]
+        analysis.save_baseline(baseline_path, findings, keep=keep)
+        print(f"lint: wrote {len(findings)} finding(s) + {len(keep)} "
+              f"kept out-of-scope entr(ies) to {baseline_path}")
+        return 0
+
+    baseline = analysis.load_baseline(baseline_path)
+    new, stale = analysis.diff_findings(findings, baseline)
+    summary = analysis.summarize(findings, new)
+    summary["baseline"] = len(baseline)
+    summary["stale_baseline_entries"] = len(stale)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "new": [f.to_dict() for f in new],
+            "summary": summary,
+        }, indent=2))
+    else:
+        report = new if args.check else findings
+        for f in report:
+            print(analysis.format_finding(f))
+        for e in stale:
+            print(f"note: stale baseline entry {e.get('rule')} "
+                  f"{e.get('file')} ({e.get('detail')}) — finding no "
+                  "longer fires; shrink the baseline")
+        kinds = ", ".join(f"{k}={v}" for k, v in summary["by_rule"].items())
+        print(f"lint: {summary['findings']} finding(s) "
+              f"({kinds or 'none'}), {len(new)} new vs baseline "
+              f"({len(baseline)} entries)")
+
+    if args.check and new:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

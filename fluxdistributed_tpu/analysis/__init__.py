@@ -1,0 +1,83 @@
+"""fdtpu-lint: JAX-hazard static analysis for this repo.
+
+Two layers (see ISSUE 5 / docs/analysis.md):
+
+* **AST rules** (:mod:`analysis.rules_ast`, run by
+  :mod:`analysis.engine`) — stdlib-``ast`` scanning for tracer
+  branches, host impurity in hot paths, weak-typed scalars, mutable
+  closure captures, hardcoded mesh-axis literals, off-convention metric
+  names, and undeclared donation.  Milliseconds, no jax import.
+* **jaxpr checks** (:mod:`analysis.jaxpr_checks` over
+  :mod:`analysis.variants`) — abstract-trace every registered
+  train-step variant and the serve engine's program pool on the
+  8-virtual-device CPU mesh, verifying sharding-spec validity,
+  donation consumability, retrace determinism (= AOT-key stability)
+  and transfer-cleanliness.
+
+``bin/lint.py`` is the CLI; ``analysis/baseline.json`` allowlists
+pre-existing findings so CI fails only on NEW ones.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .findings import (  # noqa: F401
+    Finding,
+    SEVERITIES,
+    baseline_key,
+    diff_findings,
+    format_finding,
+    load_baseline,
+    save_baseline,
+    severity_rank,
+    summarize,
+)
+from .engine import (  # noqa: F401
+    default_roots,
+    repo_root,
+    scan_paths,
+    scan_repo,
+    scanned_files,
+)
+from .rules_ast import AST_RULES, declared_mesh_axes  # noqa: F401
+
+__all__ = [
+    "AST_RULES",
+    "Finding",
+    "SEVERITIES",
+    "baseline_key",
+    "declared_mesh_axes",
+    "default_baseline_path",
+    "diff_findings",
+    "format_finding",
+    "lint_verdict",
+    "load_baseline",
+    "repo_root",
+    "save_baseline",
+    "scan_paths",
+    "scan_repo",
+    "scanned_files",
+    "severity_rank",
+    "summarize",
+]
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def lint_verdict(baseline: Optional[str] = None) -> dict:
+    """The static-health stamp for harness output (``bench.py`` embeds
+    it in its JSON line): the AST-layer rule-count summary plus how many
+    findings are NEW vs the checked-in baseline.  AST-only by design —
+    it must cost milliseconds and never trace jax programs inside a
+    bounded hardware-bench subprocess."""
+    findings = scan_repo()
+    base = load_baseline(baseline or default_baseline_path())
+    new, _ = diff_findings(findings, base)
+    out = summarize(findings, new)
+    out["baseline"] = len(base)
+    return out

@@ -1,0 +1,349 @@
+"""Layer-2 checks: abstract-trace every registered compiled program and
+verify its GSPMD-style metadata — no hardware, no backend compile.
+
+Sharding annotations, donation vectors and argument signatures are
+compile-time metadata (arXiv:2004.13336, arXiv:1810.09868); each check
+here verifies one piece of it on the 8-virtual-device CPU mesh, where a
+violation costs milliseconds instead of a dead 2400s hardware round:
+
+========  =============================================================
+FDT200    a registered variant failed to BUILD (the factory itself is
+          broken — the finding carries the exception)
+FDT201    a PartitionSpec names a mesh axis that does not exist on the
+          variant's mesh (GSPMD rejects the program at compile time)
+FDT202    a sharded dimension is not divisible by its mesh-axis size
+          (uneven shards: silent padding at best, compile error at
+          worst)
+FDT203    a buffer declared in ``donate_argnums`` has no same-shape/
+          dtype output to alias — XLA silently DROPS the donation and
+          the step pays a full copy every call
+FDT204    re-tracing with identical arguments yields a different
+          program digest — the trace is nondeterministic (host RNG /
+          wall clock / mutable global baked in), which breaks the
+          persistent compile cache AND the AOT on-disk keys
+          (compilation.py) on every process restart
+FDT205    executing one step under ``jax.transfer_guard("disallow")``
+          raised — the program implicitly moves data between host and
+          device on its hot path
+========  =============================================================
+
+``check_spec_tree`` is exposed directly (shapes + specs + mesh, no
+variant required) so tests — and future call sites like a checkpoint
+loader — can validate sharding layouts before committing memory to them.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import re
+import warnings
+from typing import Any, Iterable, List, Optional, Sequence, Tuple
+
+from .findings import Finding
+from .variants import StepVariant, build_variants
+
+__all__ = [
+    "check_spec_tree",
+    "check_variant_sharding",
+    "check_donation",
+    "check_retrace",
+    "check_transfers",
+    "check_variant",
+    "run_jaxpr_checks",
+]
+
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+_VARIANTS_SRC = "fluxdistributed_tpu/analysis/variants.py"
+
+
+def _keystr(path) -> str:
+    from jax.tree_util import keystr
+
+    s = keystr(path)
+    return s if s else "<root>"
+
+
+def _spec_entries(entry) -> Tuple[str, ...]:
+    """A PartitionSpec dim entry is None, an axis name, or a tuple of
+    axis names (multi-axis sharding of one dim)."""
+    if entry is None:
+        return ()
+    if isinstance(entry, (tuple, list)):
+        return tuple(entry)
+    return (entry,)
+
+
+def check_spec_tree(shapes, specs, mesh, *, where: str,
+                    source: str = _VARIANTS_SRC) -> List[Finding]:
+    """Validate a tree of PartitionSpecs against a tree of shapes on a
+    mesh: every named axis must exist (FDT201) and every sharded dim
+    must divide by the product of its axis sizes (FDT202).
+
+    ``shapes`` leaves are anything with ``.shape`` (arrays, ShapeDtype-
+    Structs) or raw shape tuples; ``specs`` leaves are PartitionSpecs
+    (``None`` = replicated).
+    """
+    import jax
+    from jax.sharding import PartitionSpec
+
+    mesh_axes = dict(mesh.shape)
+    out: List[Finding] = []
+
+    sflat = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: x is None or isinstance(x, PartitionSpec))[0]
+    # raw shape tuples are leaves here, not containers of ints
+    aflat = jax.tree_util.tree_flatten_with_path(
+        shapes, is_leaf=lambda x: isinstance(x, tuple) or hasattr(x, "shape"))[0]
+    if len(sflat) != len(aflat):
+        out.append(Finding(
+            rule="FDT201", severity="error", file=source, line=0,
+            message=f"{where}: spec tree has {len(sflat)} leaves but the "
+                    f"shape tree has {len(aflat)} — layouts out of sync",
+            hint="regenerate the spec tree from the live state tree",
+            detail=f"{where}:tree-mismatch"))
+        return out
+
+    for (path, aval), (_, spec) in zip(aflat, sflat):
+        shape = tuple(getattr(aval, "shape", aval if isinstance(aval, tuple) else ()))
+        if spec is None:
+            continue
+        leaf = _keystr(path)
+        for d, entry in enumerate(spec):
+            names = _spec_entries(entry)
+            if not names:
+                continue
+            if d >= len(shape):
+                out.append(Finding(
+                    rule="FDT201", severity="error", file=source, line=0,
+                    message=f"{where}: spec {tuple(spec)!r} at {leaf} has "
+                            f"more sharded dims than the rank-{len(shape)} "
+                            "array",
+                    hint="trim the PartitionSpec to the array rank",
+                    detail=f"{where}:{leaf}:rank"))
+                continue
+            size = 1
+            for a in names:
+                if a not in mesh_axes:
+                    out.append(Finding(
+                        rule="FDT201", severity="error", file=source, line=0,
+                        message=f"{where}: axis {a!r} in spec "
+                                f"{tuple(spec)!r} at {leaf} is not on the "
+                                f"mesh (axes: {sorted(mesh_axes)})",
+                        hint="use a mesh.py axis constant and build the "
+                             "mesh with that axis",
+                        detail=f"{where}:{leaf}:{a}"))
+                else:
+                    size *= mesh_axes[a]
+            if size > 1 and shape[d] % size != 0:
+                out.append(Finding(
+                    rule="FDT202", severity="error", file=source, line=0,
+                    message=f"{where}: dim {d} of {leaf} (shape {shape}) "
+                            f"is not divisible by {'x'.join(names)}="
+                            f"{size}",
+                    hint="pad the dim, resize the mesh axis, or replicate "
+                         "the leaf",
+                    detail=f"{where}:{leaf}:dim{d}"))
+    return out
+
+
+def check_variant_sharding(v: StepVariant) -> List[Finding]:
+    """Validate every concrete sharding the variant's arguments carry
+    (state AND batch) against its mesh."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    if v.mesh is None:
+        return []
+    flat = jax.tree_util.tree_flatten_with_path(v.args)[0]
+    shapes_tree = {}
+    specs_tree = {}
+    for i, (path, leaf) in enumerate(flat):
+        if isinstance(leaf, jax.Array) and isinstance(leaf.sharding, NamedSharding):
+            key = f"{i}{_keystr(path)}"
+            shapes_tree[key] = tuple(leaf.shape)
+            specs_tree[key] = leaf.sharding.spec
+    return check_spec_tree(
+        shapes_tree, specs_tree, v.mesh, where=v.name, source=v.source)
+
+
+def _aval_sig(x) -> Tuple[Tuple[int, ...], str]:
+    return (tuple(getattr(x, "shape", ())), str(getattr(x, "dtype", "?")))
+
+
+def check_donation(v: StepVariant) -> List[Finding]:
+    """Abstract-eval the program and verify every DECLARED donation has
+    a same-shape/dtype output to alias.  A donated buffer with no
+    consumer is silently dropped by XLA — the step then copies the full
+    state every call, which on a memory-tight run is the difference
+    between fitting and OOM."""
+    import jax
+
+    if not v.donate_argnums:
+        return []
+    outs = jax.eval_shape(v.fn, *v.args)
+    avail = collections.Counter(_aval_sig(x) for x in jax.tree_util.tree_leaves(outs))
+    findings: List[Finding] = []
+    dropped: collections.Counter = collections.Counter()
+    for i in v.donate_argnums:
+        for path, leaf in jax.tree_util.tree_flatten_with_path(v.args[i])[0]:
+            sig = _aval_sig(leaf)
+            if avail[sig] > 0:
+                avail[sig] -= 1
+            else:
+                dropped[sig] += 1
+                if dropped[sig] == 1:  # one finding per distinct aval
+                    findings.append(Finding(
+                        rule="FDT203", severity="error", file=v.source, line=0,
+                        message=f"{v.name}: donated input arg{i}"
+                                f"{_keystr(path)} {sig[0]}:{sig[1]} has no "
+                                "matching output to alias — XLA drops the "
+                                "donation and copies instead",
+                        hint="return an updated buffer of the same "
+                             "shape/dtype, or remove it from "
+                             "donate_argnums",
+                        detail=f"{v.name}:arg{i}:{sig[0]}:{sig[1]}"))
+    return findings
+
+
+def _lowered_digest(fn, args) -> Optional[str]:
+    lower = getattr(fn, "lower", None)
+    if lower is None:
+        return None
+    text = lower(*args).as_text()
+    return hashlib.sha256(_ADDR_RE.sub("0x", text).encode()).hexdigest()[:16]
+
+
+def check_retrace(v: StepVariant) -> List[Finding]:
+    """Trace the program twice with the SAME arguments and compare
+    program digests (memory addresses scrubbed, like compilation.py's
+    config_tag).  A digest that moves between traces means the trace
+    captures ambient state — exactly what breaks the persistent compile
+    cache and the AOT on-disk keys across process restarts, i.e. a lint
+    failure here predicts an AOT-key break."""
+    from .. import compilation
+
+    d1 = _lowered_digest(v.fn, v.args)
+    if d1 is None:
+        return []
+    d2 = _lowered_digest(v.fn, v.args)
+    if d1 == d2:
+        return []
+    # the AOT on-disk key this break invalidates (compilation.py keys
+    # executables on exactly this argument signature)
+    sig = compilation.abstract_signature(v.args)
+    return [Finding(
+        rule="FDT204", severity="error", file=v.source, line=0,
+        message=f"{v.name}: re-tracing with identical inputs produced a "
+                f"different program digest ({d1} → {d2}) — the trace "
+                "bakes in ambient state (host RNG / wall clock / mutable "
+                "global), so the compile cache and the AOT executable "
+                f"keyed on argument signature {sig} break every restart",
+        hint="move the ambient value into an argument or a fixed "
+             "constant; see FDT102/FDT104 for the usual sources",
+        detail=f"{v.name}:digest")]
+
+
+def check_transfers(v: StepVariant) -> List[Finding]:
+    """Execute the program under ``jax.transfer_guard("disallow")`` —
+    any implicit host↔device transfer on the hot path raises.
+
+    The guard applies to the STEADY-STATE call: the first call runs
+    unguarded (committing an uncommitted input once at step 0 is
+    legitimate and self-healing — the step's outputs carry the compiled
+    shardings), then the variant's ``carry`` hook threads those outputs
+    back into a second, guarded call.  A finding therefore means every
+    step of a long run pays the transfer, which is what serializes the
+    dispatch pipeline.  This is the only check that compiles and runs
+    the program, so it is opt-in per variant (``StepVariant.execute``) /
+    via ``--execute``.  NOTE: donated buffers in ``v.args`` are
+    consumed; run this check last."""
+    import jax
+
+    with warnings.catch_warnings():
+        # CPU has no donation support; the "donated buffers were not
+        # usable" warning is expected noise here, not a finding
+        # (FDT203 checks donation consumability abstractly instead)
+        warnings.simplefilter("ignore")
+        try:
+            out = v.fn(*v.args)
+            jax.block_until_ready(jax.tree_util.tree_leaves(out))
+            if v.carry is None and v.donate_argnums:
+                return []  # cannot safely re-invoke with consumed buffers
+            args2 = v.carry(v.args, out) if v.carry is not None else v.args
+        except Exception as e:  # noqa: BLE001 — sweep must survive one variant
+            # the warm-up call runs UNGUARDED — its failure is a broken
+            # program/carry hook, not a transfer violation, and must not
+            # masquerade as FDT205
+            return [Finding(
+                rule="FDT200", severity="error", file=v.source, line=0,
+                message=f"{v.name}: unguarded warm-up execution failed: "
+                        f"{type(e).__name__}: {str(e)[:200]}",
+                hint="run the variant's fn/carry directly for the full "
+                     "traceback",
+                detail=f"{v.name}:execute")]
+        try:
+            with jax.transfer_guard("disallow"):
+                out2 = v.fn(*args2)
+                jax.block_until_ready(jax.tree_util.tree_leaves(out2))
+        except Exception as e:  # noqa: BLE001 — the guard raises jax-internal types
+            return [Finding(
+                rule="FDT205", severity="error", file=v.source, line=0,
+                message=f"{v.name}: a steady-state step under "
+                        f"transfer_guard('disallow') raised "
+                        f"{type(e).__name__}: {str(e)[:200]}",
+                hint="commit inputs with jax.device_put up front; implicit "
+                     "per-step transfers serialize the dispatch pipeline",
+                detail=f"{v.name}:transfer")]
+    return []
+
+
+def check_variant(v: StepVariant, execute: Optional[bool] = None) -> List[Finding]:
+    out: List[Finding] = []
+    out += check_variant_sharding(v)
+    out += check_donation(v)
+    out += check_retrace(v)
+    if execute if execute is not None else v.execute:
+        out += check_transfers(v)
+    return out
+
+
+def run_jaxpr_checks(
+    names: Optional[Sequence[str]] = None,
+    execute: Optional[bool] = None,
+    variants: Optional[Iterable[StepVariant]] = None,
+) -> List[Finding]:
+    """Run every jaxpr-layer check over the registered variants (or the
+    given prebuilt ones).  A variant whose BUILD raises becomes an
+    FDT200 finding rather than aborting the sweep — one broken factory
+    must not mask findings in the other five."""
+    import jax
+
+    if jax.device_count() < 8 and variants is None:
+        raise RuntimeError(
+            f"jaxpr checks need the 8-virtual-device mesh, have "
+            f"{jax.device_count()} — call "
+            "fluxdistributed_tpu.mesh.force_host_devices(8) before any "
+            "jax use (bin/lint.py does)")
+    findings: List[Finding] = []
+    if variants is not None:
+        for v in variants:
+            findings.extend(check_variant(v, execute=execute))
+        return findings
+    from .variants import VARIANT_BUILDERS
+
+    for name in (names or list(VARIANT_BUILDERS)):
+        try:
+            built = build_variants([name])
+        except Exception as e:  # noqa: BLE001 — a broken factory is a finding
+            findings.append(Finding(
+                rule="FDT200", severity="error", file=_VARIANTS_SRC, line=0,
+                message=f"variant {name!r} failed to build: "
+                        f"{type(e).__name__}: {str(e)[:300]}",
+                hint="run the builder directly for the full traceback: "
+                     f"analysis.variants.build_variants(['{name}'])",
+                detail=f"{name}:build"))
+            continue
+        for v in built:
+            findings.extend(check_variant(v, execute=execute))
+    return findings

@@ -1,0 +1,736 @@
+"""Layer-1 AST rules: JAX-specific hazards detectable from source alone.
+
+Every rule targets a bug class this repo has actually hit or structurally
+risks (see ISSUE 5 / docs/analysis.md for the catalog):
+
+========  ======================================================
+FDT101    Python ``if``/``while`` on a probable tracer inside a
+          jit-reachable function (TracerBoolConversionError at best,
+          silently trace-time-frozen control flow at worst)
+FDT102    wall-clock / host RNG / host I/O inside a jitted or
+          span-bracketed hot path (baked into the trace as a constant,
+          or corrupting interval math on clock jumps)
+FDT103    ``jnp.array(<python scalar>)`` without ``dtype=`` — weak-type
+          promotion traps that retrigger compilation when mixed
+FDT104    jit-reachable closure reading a MUTABLE module global (the
+          trace captures one snapshot; later mutation silently ignored)
+FDT105    mesh-axis name literals not sourced from ``mesh.py``'s
+          declarations (unknown literal = error; a hardcoded copy of a
+          declared axis = warning — renames drift silently)
+FDT106    metric names off the byte-pinned ``fdtpu_*`` convention
+          (obs/ parity tests pin the exposition byte-for-byte)
+FDT107    a train-step factory whose docstring documents donation but
+          whose ``jax.jit`` calls never pass ``donate_argnums``
+========  ======================================================
+
+The engine is deliberately stdlib-``ast`` only: rules run anywhere (CI,
+pre-commit, the bench harness) without importing jax, in milliseconds.
+Detection is heuristic by design — the jit-reachability walk is a
+module-local name-based call graph, not an import-following analyzer —
+so rules err toward *precision* (static-by-convention accesses like
+``x.shape`` / ``isinstance(x, ...)`` are excluded) and anything
+reviewed-and-accepted goes in the baseline rather than growing a
+suppression syntax.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set
+
+from .findings import Finding
+
+__all__ = [
+    "AstRule",
+    "AST_RULES",
+    "ModuleContext",
+    "ast_rule",
+    "declared_mesh_axes",
+    "run_ast_rules",
+]
+
+#: wrapper callables whose argument (or decorated function) is traced —
+#: reachability roots.  ``shard_map`` bodies are traced exactly like jit
+#: bodies, so the same hazards apply.
+_TRACE_WRAPPERS = ("jit", "shard_map", "eval_shape", "vmap", "grad",
+                  "value_and_grad", "checkpoint", "remat", "scan",
+                  "while_loop", "fori_loop", "pmap")
+
+#: attribute accesses on a tracer that are static at trace time — a
+#: branch on these is ordinary Python, not a tracer branch
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+                 "is_deleted", "weak_type"}
+
+#: builtins whose result on a tracer is static (len → a dim, isinstance
+#: → a type test, ...)
+_STATIC_CALLS = {"isinstance", "hasattr", "callable", "len", "getattr",
+                 "type", "issubclass"}
+
+#: dotted host-side calls that must not appear in traced code: their
+#: value is captured ONCE at trace time and baked into the program
+_HOST_CALLS_IN_JIT = re.compile(
+    r"^(time\.(time|perf_counter|monotonic|sleep)"
+    r"|(np|numpy)\.random\.\w+"
+    r"|random\.(random|randint|uniform|choice|seed|gauss|shuffle)"
+    r"|open|input)$")
+
+#: the serving/training metric-name convention, byte-pinned by obs/
+#: parity tests — see obs/metrics.py
+_METRIC_NAME_RE = re.compile(r"^fdtpu_[a-z0-9_]+$")
+
+
+def _const_ints(node: ast.AST) -> List[int]:
+    vals = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    return [v.value for v in vals
+            if isinstance(v, ast.Constant) and isinstance(v.value, int)]
+
+
+def _const_strs(node: ast.AST) -> List[str]:
+    vals = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    return [v.value for v in vals
+            if isinstance(v, ast.Constant) and isinstance(v.value, str)]
+
+
+def _dotted(node: ast.AST) -> str:
+    """``a.b.c`` for Name/Attribute chains, '' for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_trace_wrapper(node: ast.AST) -> bool:
+    """Does ``node`` name a tracing wrapper (``jax.jit``, bare ``jit``,
+    ``jax.experimental.shard_map.shard_map``, ``lax.scan``, ...)?"""
+    d = _dotted(node)
+    return bool(d) and d.split(".")[-1] in _TRACE_WRAPPERS
+
+
+@dataclasses.dataclass
+class _FuncInfo:
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    qualname: str
+    params: Set[str]
+    param_order: List[str]  # positional params, for static_argnums
+    parent: Optional["_FuncInfo"]
+
+
+class ModuleContext:
+    """One parsed module + the derived facts rules share: the function
+    index, the jit-reachable set, and the mesh-axis declarations."""
+
+    def __init__(self, path: str, relpath: str, source: str,
+                 tree: ast.Module, axes: Optional[Set[str]] = None):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.tree = tree
+        self.axes = axes if axes is not None else declared_mesh_axes()
+        self.functions: List[_FuncInfo] = []
+        self._by_name: Dict[str, List[_FuncInfo]] = {}
+        self._index_functions()
+        #: per entry-function name: the static_argnums/static_argnames
+        #: its wrapper call declares (those params are NOT tracers)
+        self.entry_static: Dict[str, Dict[str, tuple]] = {}
+        entries = self._entry_names()
+        self.jit_entries: Set[int] = {
+            id(f.node) for f in self.functions
+            if f.node.name in entries}
+        self.jit_reachable: Set[int] = self._jit_reachable(entries)
+
+    # -- function index ----------------------------------------------------
+
+    def _index_functions(self) -> None:
+        ctx = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.stack: List[_FuncInfo] = []
+
+            def _visit_func(self, node):
+                order = [a.arg for a in
+                         (node.args.posonlyargs + node.args.args)
+                         if a.arg not in ("self", "cls")]
+                params = set(order) | {
+                    a.arg for a in node.args.kwonlyargs
+                } | {a.arg for a in (node.args.vararg, node.args.kwarg) if a}
+                qual = ".".join([f.node.name for f in self.stack] + [node.name])
+                info = _FuncInfo(node, qual, params, order,
+                                 self.stack[-1] if self.stack else None)
+                ctx.functions.append(info)
+                ctx._by_name.setdefault(node.name, []).append(info)
+                self.stack.append(info)
+                self.generic_visit(node)
+                self.stack.pop()
+
+            visit_FunctionDef = _visit_func
+            visit_AsyncFunctionDef = _visit_func
+
+        V().visit(self.tree)
+
+    def own_nodes(self, info: _FuncInfo) -> Iterable[ast.AST]:
+        """Nodes of a function's immediate body, not descending into
+        nested function definitions (those are their own _FuncInfo)."""
+        stack: List[ast.AST] = list(ast.iter_child_nodes(info.node))
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+    # -- jit reachability --------------------------------------------------
+
+    def _entry_names(self) -> Set[str]:
+        """Function names handed to a tracing wrapper anywhere in the
+        module: ``jax.jit(step)``, ``@jax.jit``, ``@partial(jax.jit,
+        ...)``, ``jax.jit(self._step_impl, ...)``."""
+        names: Set[str] = set()
+
+        def record_static(name: str, call: Optional[ast.Call]) -> None:
+            info = self.entry_static.setdefault(
+                name, {"argnums": (), "argnames": ()})
+            if call is None:
+                return
+            for k in call.keywords:
+                if k.arg == "static_argnums":
+                    info["argnums"] = tuple(_const_ints(k.value))
+                elif k.arg == "static_argnames":
+                    info["argnames"] = tuple(_const_strs(k.value))
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if _is_trace_wrapper(target):
+                        names.add(node.name)
+                        record_static(
+                            node.name,
+                            dec if isinstance(dec, ast.Call) else None)
+                    elif (isinstance(dec, ast.Call)
+                          and _dotted(dec.func).split(".")[-1] == "partial"
+                          and dec.args and _is_trace_wrapper(dec.args[0])):
+                        names.add(node.name)
+                        record_static(node.name, dec)
+            elif isinstance(node, ast.Call) and _is_trace_wrapper(node.func):
+                # ALL positional name args, not just the first: the
+                # traced callable's position varies (``fori_loop(0, n,
+                # body, x)``, ``while_loop(cond, body, x)`` traces two)
+                for arg in node.args:
+                    d = _dotted(arg)
+                    if d:
+                        names.add(d.split(".")[-1])
+                        record_static(d.split(".")[-1], node)
+        return names
+
+    def _jit_reachable(self, entries: Set[str]) -> Set[int]:
+        """ids of _FuncInfo nodes traced under some wrapper: the entry
+        functions plus everything they reference by name (called OR
+        passed as a callback — ``lax.scan(body, ...)``,
+        ``tree_map(leaf, ...)`` and ``value_and_grad(lossf)`` all trace
+        their argument)."""
+        reachable: Set[int] = set()
+        work: List[_FuncInfo] = []
+        for info in self.functions:
+            if info.node.name in entries:
+                work.append(info)
+        while work:
+            info = work.pop()
+            if id(info.node) in reachable:
+                continue
+            reachable.add(id(info.node))
+            for n in self.own_nodes(info):
+                d = _dotted(n) if isinstance(n, (ast.Name, ast.Attribute)) else ""
+                if not d:
+                    continue
+                leaf = d.split(".")[-1]
+                for cand in self._by_name.get(leaf, []):
+                    if id(cand.node) not in reachable:
+                        work.append(cand)
+        return reachable
+
+    def jit_functions(self) -> List[_FuncInfo]:
+        return [f for f in self.functions if id(f.node) in self.jit_reachable]
+
+
+# -- mesh axis declarations ----------------------------------------------
+
+_AXES_CACHE: Optional[Set[str]] = None
+
+
+def declared_mesh_axes(mesh_path: Optional[str] = None) -> Set[str]:
+    """The axis-name literals declared as ``*_AXIS = "..."`` in
+    ``mesh.py`` — THE source of truth every other axis mention must
+    derive from.  Parsed from source (not imported) so the linter works
+    without jax on the path."""
+    global _AXES_CACHE
+    if mesh_path is None and _AXES_CACHE is not None:
+        return _AXES_CACHE
+    import os
+
+    path = mesh_path or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "mesh.py")
+    axes: Set[str] = set()
+    try:
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+    except (OSError, SyntaxError, ValueError):
+        # axes UNKNOWN (mesh.py unreadable/mid-edit) — FDT105 must then
+        # stand down entirely rather than call every literal undeclared;
+        # the empty set signals that (and FDT000 reports the parse error)
+        return set()
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.endswith("_AXIS")
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            axes.add(node.value.value)
+    if mesh_path is None:
+        _AXES_CACHE = axes
+    return axes
+
+
+# -- rule registry --------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class AstRule:
+    id: str
+    name: str
+    severity: str
+    description: str
+    hint: str
+    check: Callable[[ModuleContext], Iterable[Finding]]
+
+
+AST_RULES: List[AstRule] = []
+
+
+def ast_rule(id: str, name: str, severity: str, description: str, hint: str):
+    """Register an AST rule.  ``check(ctx)`` yields findings; the
+    decorator fills rule id / severity / hint so rule bodies only state
+    locations and messages."""
+
+    def deco(fn):
+        rule = AstRule(id, name, severity, description, hint, fn)
+        AST_RULES.append(rule)
+        return fn
+
+    return deco
+
+
+def _finding(rule: AstRule, ctx: ModuleContext, node: ast.AST,
+             message: str, detail: str, severity: Optional[str] = None,
+             hint: Optional[str] = None) -> Finding:
+    return Finding(
+        rule=rule.id,
+        severity=severity or rule.severity,
+        file=ctx.relpath,
+        line=getattr(node, "lineno", 0),
+        message=message,
+        hint=hint if hint is not None else rule.hint,
+        detail=detail,
+    )
+
+
+def _rule_by_id(rid: str) -> AstRule:
+    return next(r for r in AST_RULES if r.id == rid)
+
+
+def run_ast_rules(ctx: ModuleContext,
+                  rules: Optional[Sequence[AstRule]] = None) -> List[Finding]:
+    out: List[Finding] = []
+    for rule in (rules or AST_RULES):
+        out.extend(rule.check(ctx))
+    return out
+
+
+# -- FDT101: tracer branch -------------------------------------------------
+
+def _dynamic_param_uses(test: ast.AST, params: Set[str]) -> List[ast.Name]:
+    """Name nodes in ``test`` that reference a traced parameter in a way
+    that needs its VALUE (not static metadata like ``.shape``)."""
+    hits: List[ast.Name] = []
+
+    def walk(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return  # x.shape / x.dtype — static at trace time
+        if (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Name)
+                and n.func.id in _STATIC_CALLS):
+            return  # isinstance(x, ...) / len(x) — static
+        if (isinstance(n, ast.Compare)
+                and all(isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops)):
+            return  # x is None — identity, not value
+        if isinstance(n, ast.Name) and n.id in params:
+            hits.append(n)
+        for c in ast.iter_child_nodes(n):
+            walk(c)
+
+    walk(test)
+    return hits
+
+
+@ast_rule(
+    "FDT101", "tracer-branch", "warning",
+    "Python `if`/`while` on a probable tracer inside a jit-reachable "
+    "function — control flow freezes at trace time (or raises "
+    "TracerBoolConversionError).",
+    "use jnp.where / lax.cond / lax.while_loop, or hoist the branch out "
+    "of the traced function (closure constants branch fine)")
+def _check_tracer_branch(ctx: ModuleContext) -> Iterable[Finding]:
+    rule = _rule_by_id("FDT101")
+    # entry functions ONLY: a direct jit/shard_map target's parameters
+    # are tracers by construction (minus declared static args), while
+    # helpers reached transitively often take static config params —
+    # flagging those would drown the signal
+    for info in ctx.functions:
+        if id(info.node) not in ctx.jit_entries:
+            continue
+        static = ctx.entry_static.get(info.node.name,
+                                      {"argnums": (), "argnames": ()})
+        params = set(info.params) - set(static["argnames"])
+        for i in static["argnums"]:
+            if 0 <= i < len(info.param_order):
+                params.discard(info.param_order[i])
+        for n in ctx.own_nodes(info):
+            if not isinstance(n, (ast.If, ast.While)):
+                continue
+            for name in _dynamic_param_uses(n.test, params):
+                kind = "while" if isinstance(n, ast.While) else "if"
+                yield _finding(
+                    rule, ctx, n,
+                    f"`{kind} ...{name.id}...` branches on parameter "
+                    f"{name.id!r} of traced function {info.qualname}()",
+                    detail=f"{info.qualname}:{name.id}")
+                break  # one finding per statement
+
+
+# -- FDT102: host calls in hot paths --------------------------------------
+
+def _span_bracketed(ctx: ModuleContext, info: _FuncInfo) -> bool:
+    """Does this function open obs-style phase/span brackets (`with
+    phases(...)` / `with tracer.span(...)`)?  Such functions are hot
+    paths by declaration — their timing math must be monotonic."""
+    for n in ctx.own_nodes(info):
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                e = item.context_expr
+                if isinstance(e, ast.Call):
+                    d = _dotted(e.func)
+                    if d.split(".")[-1] in ("span", "phases"):
+                        return True
+    return False
+
+
+@ast_rule(
+    "FDT102", "host-call-in-hot-path", "warning",
+    "wall-clock / host RNG / host I/O inside a jitted function (baked "
+    "into the trace as a constant) or `time.time()` inside a "
+    "span-bracketed hot path (wall clock jumps corrupt interval math).",
+    "in traced code: jax.random / jax.debug.print / pass values as "
+    "arguments; in span-bracketed host loops: time.perf_counter()")
+def _check_host_calls(ctx: ModuleContext) -> Iterable[Finding]:
+    rule = _rule_by_id("FDT102")
+    jit_ids = ctx.jit_reachable
+    for info in ctx.functions:
+        in_jit = id(info.node) in jit_ids
+        spanned = False if in_jit else _span_bracketed(ctx, info)
+        if not (in_jit or spanned):
+            continue
+        for n in ctx.own_nodes(info):
+            if not isinstance(n, ast.Call):
+                continue
+            d = _dotted(n.func)
+            if in_jit and _HOST_CALLS_IN_JIT.match(d):
+                yield _finding(
+                    rule, ctx, n,
+                    f"host call {d}() inside traced function "
+                    f"{info.qualname}() — evaluated ONCE at trace time, "
+                    "then a constant in every execution",
+                    detail=f"{info.qualname}:{d}")
+            elif spanned and d == "time.time":
+                yield _finding(
+                    rule, ctx, n,
+                    f"time.time() in span-bracketed hot path "
+                    f"{info.qualname}() — wall clock is not monotonic; "
+                    "NTP steps/DST corrupt rates and span math",
+                    detail=f"{info.qualname}:time.time")
+
+
+# -- FDT103: weak-typed scalar --------------------------------------------
+
+def _is_scalar_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)) \
+            and not isinstance(node.value, bool):
+        return True
+    return (isinstance(node, ast.UnaryOp)
+            and isinstance(node.op, (ast.USub, ast.UAdd))
+            and _is_scalar_literal(node.operand))
+
+
+@ast_rule(
+    "FDT103", "weak-scalar", "warning",
+    "`jnp.array(<python scalar>)` without dtype= in traced code — the "
+    "weak-typed result changes promotion, and at jit boundaries a "
+    "scalar-vs-array dtype flip retriggers compilation.",
+    "pin it: jnp.array(x, dtype=jnp.float32) (or jnp.int32), or use "
+    "jnp.zeros/ones with an explicit dtype")
+def _check_weak_scalar(ctx: ModuleContext) -> Iterable[Finding]:
+    rule = _rule_by_id("FDT103")
+    for info in ctx.jit_functions():
+        for n in ctx.own_nodes(info):
+            if not isinstance(n, ast.Call):
+                continue
+            d = _dotted(n.func)
+            if d.split(".")[-1] not in ("array", "asarray") or \
+                    not d.startswith(("jnp.", "jax.numpy.")):
+                continue
+            if not n.args or not _is_scalar_literal(n.args[0]):
+                continue
+            has_dtype = len(n.args) >= 2 or any(
+                k.arg == "dtype" for k in n.keywords)
+            if not has_dtype:
+                yield _finding(
+                    rule, ctx, n,
+                    f"{d}({ast.unparse(n.args[0])}) without dtype= in "
+                    f"traced function {info.qualname}() is weak-typed",
+                    detail=f"{info.qualname}:{ast.unparse(n.args[0])}")
+
+
+# -- FDT104: mutable global captured by a traced closure ------------------
+
+def _mutable_globals(ctx: ModuleContext) -> Set[str]:
+    muts: Set[str] = set()
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            v = node.value
+            if isinstance(v, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                              ast.DictComp, ast.SetComp)):
+                muts.add(node.targets[0].id)
+            elif isinstance(v, ast.Call) and _dotted(v.func) in (
+                    "list", "dict", "set", "collections.defaultdict",
+                    "collections.OrderedDict"):
+                muts.add(node.targets[0].id)
+    # anything rebound via `global NAME` is mutable by definition
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Global):
+            muts.update(node.names)
+    return muts
+
+
+@ast_rule(
+    "FDT104", "mutable-global-in-jit", "warning",
+    "a traced function reads a mutable module global — the trace "
+    "captures ONE snapshot; later mutation is silently ignored by "
+    "every compiled execution.",
+    "pass the value as an argument (retraces on change) or make the "
+    "global an immutable constant (tuple / frozen dataclass)")
+def _check_mutable_global(ctx: ModuleContext) -> Iterable[Finding]:
+    rule = _rule_by_id("FDT104")
+    muts = _mutable_globals(ctx)
+    if not muts:
+        return
+    for info in ctx.jit_functions():
+        locals_: Set[str] = set(info.params)
+        for n in ctx.own_nodes(info):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                locals_.add(n.id)
+        seen: Set[str] = set()
+        for n in ctx.own_nodes(info):
+            if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                    and n.id in muts and n.id not in locals_
+                    and n.id not in seen):
+                seen.add(n.id)
+                yield _finding(
+                    rule, ctx, n,
+                    f"traced function {info.qualname}() reads mutable "
+                    f"module global {n.id!r}",
+                    detail=f"{info.qualname}:{n.id}")
+
+
+# -- FDT105: axis-name literals -------------------------------------------
+
+def _axis_literal_findings(ctx: ModuleContext, rule: AstRule):
+    if ctx.relpath.replace("\\", "/").endswith("fluxdistributed_tpu/mesh.py"):
+        return  # the declarations themselves
+    axes = ctx.axes
+    if not axes:
+        return  # axes unknown (mesh.py unparseable) is not axes invalid
+    func_stack: List[str] = []
+
+    def fname() -> str:
+        return func_stack[-1] if func_stack else "<module>"
+
+    def walk(node: ast.AST):
+        is_func = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        if is_func:
+            func_stack.append(node.name)
+            # (c) parameter defaults for *axis* parameters
+            a = node.args
+            pos = a.posonlyargs + a.args
+            for arg, default in zip(pos[len(pos) - len(a.defaults):],
+                                    a.defaults):
+                if arg.arg.endswith("axis") and isinstance(default, ast.Constant) \
+                        and isinstance(default.value, str) and default.value in axes:
+                    yield _finding(
+                        rule, ctx, default,
+                        f"default {arg.arg}={default.value!r} hardcodes a "
+                        "mesh axis name",
+                        detail=f"{node.name}:{arg.arg}={default.value}",
+                        severity="warning",
+                        hint="default it to the mesh constant "
+                             "(mesh.DATA_AXIS / MODEL_AXIS / ...) so a "
+                             "rename cannot drift")
+            for kwarg, default in zip(a.kwonlyargs, a.kw_defaults):
+                if default is not None and kwarg.arg.endswith("axis") \
+                        and isinstance(default, ast.Constant) \
+                        and isinstance(default.value, str) and default.value in axes:
+                    yield _finding(
+                        rule, ctx, default,
+                        f"default {kwarg.arg}={default.value!r} hardcodes a "
+                        "mesh axis name",
+                        detail=f"{node.name}:{kwarg.arg}={default.value}",
+                        severity="warning",
+                        hint="default it to the mesh constant so a rename "
+                             "cannot drift")
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d.split(".")[-1] in ("P", "PartitionSpec"):
+                # (a) P()/PartitionSpec() arguments, including tuples
+                for arg in node.args:
+                    elts = arg.elts if isinstance(arg, ast.Tuple) else [arg]
+                    for e in elts:
+                        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                            if e.value not in axes:
+                                yield _finding(
+                                    rule, ctx, e,
+                                    f"PartitionSpec axis {e.value!r} is not "
+                                    "declared in mesh.py — GSPMD will "
+                                    "reject it at compile time on any "
+                                    "real mesh",
+                                    detail=f"{fname()}:P:{e.value}")
+                            else:
+                                yield _finding(
+                                    rule, ctx, e,
+                                    f"PartitionSpec hardcodes axis "
+                                    f"{e.value!r} as a string literal",
+                                    detail=f"{fname()}:P:{e.value}",
+                                    severity="warning",
+                                    hint="use the mesh constant "
+                                         "(mesh.DATA_AXIS / ...) instead "
+                                         "of the literal")
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id.endswith("_AXIS") \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str) \
+                and node.value.value in axes:
+            # (b) a duplicate declaration of a mesh.py axis
+            yield _finding(
+                rule, ctx, node,
+                f"{node.targets[0].id} = {node.value.value!r} re-declares "
+                "a mesh.py axis literal — renames drift silently",
+                detail=f"{fname()}:{node.targets[0].id}",
+                severity="warning",
+                hint="import the constant from fluxdistributed_tpu.mesh "
+                     "instead of re-declaring the literal")
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.value, ast.Attribute) \
+                and node.value.attr == "shape" \
+                and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str) \
+                and node.slice.value in axes:
+            # (d) mesh.shape["pipe"]-style lookups
+            yield _finding(
+                rule, ctx, node,
+                f".shape[{node.slice.value!r}] hardcodes a mesh axis name",
+                detail=f"{fname()}:shape:{node.slice.value}",
+                severity="warning",
+                hint="index with the mesh constant (mesh.PIPE_AXIS / ...)")
+        for child in ast.iter_child_nodes(node):
+            yield from walk(child)
+        if is_func:
+            func_stack.pop()
+
+    yield from walk(ctx.tree)
+
+
+@ast_rule(
+    "FDT105", "axis-literal", "error",
+    "mesh-axis name literals not sourced from mesh.py's declarations: "
+    "an unknown literal fails GSPMD partitioning at compile time; a "
+    "hardcoded copy of a declared axis drifts silently on rename.",
+    "source axis names from fluxdistributed_tpu.mesh constants")
+def _check_axis_literal(ctx: ModuleContext) -> Iterable[Finding]:
+    yield from _axis_literal_findings(ctx, _rule_by_id("FDT105"))
+
+
+# -- FDT106: metric-name convention ---------------------------------------
+
+@ast_rule(
+    "FDT106", "metric-name", "warning",
+    "a metric registered off the byte-pinned `fdtpu_*` snake_case "
+    "convention — dashboards and the obs/ exposition parity tests key "
+    "on the prefix.",
+    "name it fdtpu_<subsystem>_<what>_<unit> (e.g. "
+    "fdtpu_train_step_seconds)")
+def _check_metric_names(ctx: ModuleContext) -> Iterable[Finding]:
+    rule = _rule_by_id("FDT106")
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Attribute):
+            continue
+        if node.func.attr not in ("counter", "gauge", "histogram"):
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, str):
+            continue
+        name = node.args[0].value
+        if not _METRIC_NAME_RE.match(name):
+            yield _finding(
+                rule, ctx, node,
+                f"metric name {name!r} violates the fdtpu_* convention",
+                detail=name)
+
+
+# -- FDT107: donation documented but not declared --------------------------
+
+@ast_rule(
+    "FDT107", "donation-undeclared", "warning",
+    "a step factory whose docstring documents donation but whose "
+    "jax.jit calls never pass donate_argnums — callers believe buffers "
+    "are reused while every step silently copies the full state.",
+    "pass donate_argnums=(0,) (or donate_argnames) to the jit call, "
+    "gated on the factory's donate flag")
+def _check_donation_doc(ctx: ModuleContext) -> Iterable[Finding]:
+    rule = _rule_by_id("FDT107")
+    for info in ctx.functions:
+        node = info.node
+        if not node.name.startswith("make_"):
+            continue
+        doc = ast.get_docstring(node) or ""
+        if "donat" not in doc.lower():
+            continue
+        jit_calls = [
+            n for n in ctx.own_nodes(info)
+            if isinstance(n, ast.Call) and _dotted(n.func).split(".")[-1] == "jit"
+        ]
+        if not jit_calls:
+            continue
+        if not any(
+            k.arg in ("donate_argnums", "donate_argnames")
+            for c in jit_calls for k in c.keywords
+        ):
+            yield _finding(
+                rule, ctx, node,
+                f"{info.qualname}() documents donation but none of its "
+                f"{len(jit_calls)} jax.jit call(s) pass donate_argnums",
+                detail=info.qualname)

@@ -1,0 +1,224 @@
+"""The jaxpr-layer check targets: every registered train-step variant +
+the serve engine's compiled program pool, built tiny on the 8-virtual-
+device CPU mesh.
+
+Each entry goes through the REAL registered path — ``prepare_training``
+for the parallelism modes, ``LMEngine`` for serving — with toy model
+sizes, so what the static layer validates is exactly the code a real run
+compiles: the step factories, the sharding layouts, the donation
+vectors.  Nothing here executes a step by default (building a variant
+traces nothing); the jaxpr checks lower/abstract-eval the returned
+callables on CPU in seconds where a hardware bench round would burn
+minutes discovering the same bug.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["StepVariant", "VARIANT_BUILDERS", "variant_names", "build_variants"]
+
+
+@dataclasses.dataclass
+class StepVariant:
+    """One compiled-program check target.
+
+    ``fn(*args)`` is the jit-wrapped program; ``donate_argnums`` is what
+    the variant DECLARES it donates (the jaxpr layer verifies the
+    declaration is consumable); ``source`` is the repo-relative file of
+    the factory the findings should point at.  ``execute=True`` marks
+    the variant cheap enough for the optional transfer-guard execution
+    check (one real compiled step on CPU)."""
+
+    name: str
+    fn: Callable
+    args: Tuple[Any, ...]
+    donate_argnums: Tuple[int, ...]
+    mesh: Any
+    source: str
+    execute: bool = False
+    #: thread one call's outputs into the next call's arguments — the
+    #: steady-state input for the guarded second call of the transfer
+    #: check (required for executing variants that donate buffers)
+    carry: Optional[Callable[[Tuple, Any], Tuple]] = None
+
+
+def _src(module) -> str:
+    """Repo-relative path of a module's source file."""
+    from .engine import repo_root
+
+    path = os.path.abspath(module.__file__)
+    try:
+        rel = os.path.relpath(path, repo_root())
+    except ValueError:
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+def _image_setup():
+    from ..data.synthetic import SyntheticDataset
+    from ..models.simple import SimpleCNN
+
+    return (SimpleCNN(num_classes=4, features=8),
+            SyntheticDataset(nsamples=64, nclasses=4, shape=(8, 8, 3)))
+
+
+def _lm_setup(depth: int, heads: int, attn_fn=None):
+    import jax.numpy as jnp
+
+    from ..data.synthetic import SyntheticTextDataset
+    from ..models.transformer_lm import TransformerLM
+
+    model = TransformerLM(
+        vocab=32, dim=16, depth=depth, num_heads=heads, mlp_dim=32,
+        dtype=jnp.float32, dropout=0.0, attn_fn=attn_fn)
+    return model, SyntheticTextDataset(vocab=32, seqlen=16)
+
+
+def _prepared(name: str, model, dataset, mesh, source_mod,
+              execute: bool = False, **kw) -> List[StepVariant]:
+    """Run the real ``prepare_training`` path and wrap its compiled step
+    as a check target (donate=True so the donation vector is live)."""
+    from .. import optim
+    from ..train.trainer import _dummy_batch, prepare_training
+
+    task = prepare_training(
+        model, dataset, optim.adam(1e-3), mesh=mesh, batch_size=16,
+        cycles=1, donate=True, **kw)
+    batch = _dummy_batch(dataset, None, 16, mesh, 1, seed=0)
+    return [StepVariant(
+        name=name, fn=task.step_fn, args=(task.state, batch),
+        donate_argnums=(0,), mesh=mesh, source=_src(source_mod),
+        execute=execute,
+        # (state, batch) → ((new_state, metrics)) → (new_state, batch)
+        carry=lambda args, out: (out[0], args[1]))]
+
+
+def _build_dp() -> List[StepVariant]:
+    from .. import mesh as mesh_lib
+    from ..parallel import dp
+
+    model, ds = _image_setup()
+    return _prepared("dp", model, ds, mesh_lib.data_mesh(8), dp,
+                     execute=True, spmd="jit")
+
+
+def _build_zero1() -> List[StepVariant]:
+    from .. import mesh as mesh_lib
+    from ..parallel import zero1
+
+    model, ds = _image_setup()
+    return _prepared("zero1", model, ds, mesh_lib.data_mesh(8), zero1,
+                     execute=True, spmd="jit", zero1=True)
+
+
+def _build_fsdp() -> List[StepVariant]:
+    from .. import mesh as mesh_lib
+    from ..parallel import fsdp
+
+    model, ds = _image_setup()
+    return _prepared("fsdp", model, ds, mesh_lib.data_mesh(8), fsdp,
+                     execute=True, spmd="fsdp")
+
+
+def _build_tp() -> List[StepVariant]:
+    from .. import mesh as mesh_lib
+    from ..models.transformer_lm import lm_loss_fn
+    from ..parallel import tp
+
+    mesh = mesh_lib.make_mesh(
+        {mesh_lib.DATA_AXIS: 2, mesh_lib.MODEL_AXIS: 4})
+    model, ds = _lm_setup(depth=1, heads=4)
+    return _prepared("tp", model, ds, mesh, tp, spmd="tp",
+                     loss_fn=lm_loss_fn(model), topk=())
+
+
+def _build_pp_1f1b() -> List[StepVariant]:
+    from .. import mesh as mesh_lib
+    from ..parallel import pp_1f1b
+
+    mesh = mesh_lib.make_mesh(
+        {mesh_lib.DATA_AXIS: 2, mesh_lib.PIPE_AXIS: 4})
+    model, ds = _lm_setup(depth=4, heads=2)
+    return _prepared("pp_1f1b", model, ds, mesh, pp_1f1b,
+                     spmd="pp_1f1b", num_microbatches=2, topk=())
+
+
+def _build_context() -> List[StepVariant]:
+    from .. import mesh as mesh_lib
+    from ..models.transformer_lm import lm_loss_fn
+    from ..parallel import context
+
+    mesh = mesh_lib.make_mesh(
+        {mesh_lib.DATA_AXIS: 2, mesh_lib.SEQ_AXIS: 4})
+    model, ds = _lm_setup(
+        depth=1, heads=4,
+        attn_fn=context.make_ring_attention(
+            mesh, batch_axis=mesh_lib.DATA_AXIS, causal=True))
+    return _prepared("context", model, ds, mesh, context, spmd="sp",
+                     loss_fn=lm_loss_fn(model), topk=())
+
+
+def _build_serve() -> List[StepVariant]:
+    """The engine's per-program pool: one prefill per bucket, the slot
+    splice, the all-slot decode step — with the donation vectors the
+    engine declares (cache/token/key state updated in place)."""
+    import jax
+
+    from ..serve import engine as engine_mod
+
+    model, _ = _lm_setup(depth=1, heads=2)
+    params = model.init(jax.random.PRNGKey(0),
+                        jax.numpy.zeros((1, 8), "int32"), train=False)["params"]
+    eng = engine_mod.LMEngine(model, params, max_slots=2, max_len=64,
+                              buckets=(16, 32))
+    src = _src(engine_mod)
+    out = [
+        StepVariant(name="serve:step", fn=eng._step_jit,
+                    args=eng._example_args("step"),
+                    donate_argnums=(1, 2, 4), mesh=None, source=src,
+                    # (params, cache, tok, temp, keys) → (cache', tok', keys')
+                    carry=lambda a, o: (a[0], o[0], o[1], a[3], o[2])),
+        StepVariant(name="serve:insert", fn=eng._insert_jit,
+                    args=eng._example_args("insert"),
+                    donate_argnums=(0,), mesh=None, source=src,
+                    # (big, small, slot, plen) → spliced big cache
+                    carry=lambda a, o: (o, a[1], a[2], a[3])),
+    ]
+    for b in eng.buckets:
+        out.append(StepVariant(
+            name=f"serve:prefill_b{b}", fn=eng._prefill_jit,
+            args=eng._example_args("prefill", b),
+            donate_argnums=(), mesh=None, source=src))
+    return out
+
+
+#: name → builder; the six parallelism variants the acceptance gate
+#: names, plus the serve engine's program pool
+VARIANT_BUILDERS: Dict[str, Callable[[], List[StepVariant]]] = {
+    "dp": _build_dp,
+    "zero1": _build_zero1,
+    "fsdp": _build_fsdp,
+    "tp": _build_tp,
+    "pp_1f1b": _build_pp_1f1b,
+    "context": _build_context,
+    "serve": _build_serve,
+}
+
+
+def variant_names() -> List[str]:
+    return list(VARIANT_BUILDERS)
+
+
+def build_variants(names: Optional[Sequence[str]] = None) -> List[StepVariant]:
+    """Build the named variants (default: all).  Unknown names raise —
+    a typo in a CI invocation must not silently skip a variant."""
+    out: List[StepVariant] = []
+    for n in (names or variant_names()):
+        if n not in VARIANT_BUILDERS:
+            raise ValueError(
+                f"unknown variant {n!r}; registered: {variant_names()}")
+        out.extend(VARIANT_BUILDERS[n]())
+    return out

@@ -170,7 +170,9 @@ def abstract_signature(args: Sequence[Any], kwargs: Optional[dict] = None) -> st
     arguments — the part of an executable's identity the topology
     fingerprint does not cover.  Two calls with the same signature and
     fingerprint may share a serialized executable; anything else must
-    not."""
+    not.  fdtpu-lint's FDT204 retrace check builds on this digest: a
+    program whose trace moves under a fixed signature would break these
+    on-disk keys on every restart (docs/analysis.md)."""
     import jax
 
     leaves, treedef = jax.tree.flatten((tuple(args), kwargs or {}))

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from ..mesh import EXPERT_AXIS, PIPE_AXIS
 from ..ops.attention import dot_product_attention
 from .common import maybe_remat
 
@@ -925,7 +926,7 @@ def _pp_state_shardings(mesh, pipe_axis: str):
 def lm_pp(
     model: TransformerLM,
     mesh,
-    pipe_axis: str = "pipe",
+    pipe_axis: str = PIPE_AXIS,
     batch_axis: Optional[str] = None,
     num_microbatches: Optional[int] = None,
     remat: bool = False,
@@ -1005,7 +1006,7 @@ class LMPipelineWiring(NamedTuple):
 def lm_pp_1f1b(
     model: TransformerLM,
     mesh,
-    pipe_axis: str = "pipe",
+    pipe_axis: str = PIPE_AXIS,
     interleave: bool = False,
 ):
     """Pipeline-parallelize the LM on the hand-scheduled 1F1B schedule
@@ -1064,7 +1065,7 @@ def lm_pp_1f1b(
     )
 
 
-def lm_moe_specs(params, axis: str = "expert"):
+def lm_moe_specs(params, axis: str = EXPERT_AXIS):
     """PartitionSpec tree for an MoE LM's params: expert-stacked leaves
     (``w1/b1/w2/b2`` inside MoE blocks, leading dim E) sharded over
     ``axis``; routers and every dense leaf replicated.  Feed through

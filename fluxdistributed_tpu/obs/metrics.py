@@ -25,6 +25,10 @@ Design points:
 * **callback gauges** — ``Gauge.set_function`` renders a value computed
   at scrape time (queue depth, compile-cache size) so hot paths never
   pay for bookkeeping the scraper can derive.
+* **naming** — every metric is ``fdtpu_<subsystem>_<what>_<unit>``
+  snake_case; the serve parity tests pin the exposition byte-for-byte
+  and fdtpu-lint's FDT106 rule enforces the prefix statically at every
+  registration site (docs/analysis.md).
 """
 
 from __future__ import annotations

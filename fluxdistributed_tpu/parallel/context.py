@@ -41,6 +41,7 @@ from ..ops.attention import (
     attn_finalize,
     attn_init,
 )
+from ..mesh import SEQ_AXIS
 
 __all__ = [
     "ring_attention",
@@ -165,7 +166,7 @@ def ring_flash_attention(
 
 def make_ring_attention(
     mesh: Mesh,
-    seq_axis: str = "seq",
+    seq_axis: str = SEQ_AXIS,
     batch_axis: Optional[str] = None,
     causal: bool = False,
     impl: str = "xla",
@@ -253,7 +254,7 @@ def ulysses_attention(
 
 def make_ulysses_attention(
     mesh: Mesh,
-    seq_axis: str = "seq",
+    seq_axis: str = SEQ_AXIS,
     batch_axis: Optional[str] = None,
     causal: bool = False,
 ):

@@ -42,7 +42,10 @@ __all__ = [
     "stack_expert_params",
 ]
 
-EXPERT_AXIS = "expert"
+# sourced from the device layer's single declaration (lint rule FDT105:
+# a re-declared literal drifts silently on rename); re-exported here for
+# the callers that import it from the ep module
+from ..mesh import EXPERT_AXIS
 
 
 def stack_expert_params(per_expert: list, mesh: Mesh, axis: str = EXPERT_AXIS) -> Pytree:

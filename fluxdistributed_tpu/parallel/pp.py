@@ -50,7 +50,10 @@ __all__ = [
     "chunk_stages",
 ]
 
-PIPE_AXIS = "pipe"
+# sourced from the device layer's single declaration (lint rule FDT105:
+# a re-declared literal drifts silently on rename); re-exported here for
+# the callers that import it from the pp module
+from ..mesh import PIPE_AXIS
 
 
 def _accepts_stage(fn: Callable) -> bool:

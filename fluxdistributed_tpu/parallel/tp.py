@@ -97,7 +97,7 @@ def shard_state(state: TrainState, mesh: Mesh, p_specs: Pytree) -> TrainState:
     return jax.tree.map(put, state, specs, is_leaf=lambda x: x is None)
 
 
-def vit_tp_rules(model_axis: str = "model") -> Callable[[str, Any], P]:
+def vit_tp_rules(model_axis: str = mesh_lib.MODEL_AXIS) -> Callable[[str, Any], P]:
     """Megatron-style sharding rules for ``models.vit.ViT`` param paths.
 
     qkv kernel  [dim, 3, heads, head_dim] → heads sharded (column)
@@ -127,7 +127,7 @@ def vit_tp_rules(model_axis: str = "model") -> Callable[[str, Any], P]:
 
 
 def lm_tp_rules(
-    model_axis: str = "model", shard_vocab: bool = True
+    model_axis: str = mesh_lib.MODEL_AXIS, shard_vocab: bool = True
 ) -> Callable[[str, Any], P]:
     """Megatron-style rules for ``models.transformer_lm.TransformerLM``.
 

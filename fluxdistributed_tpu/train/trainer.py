@@ -128,6 +128,7 @@ def prepare_training(
     cache_dir: Optional[str] = None,
     aot: Optional[str] = None,
     warmup: bool = False,
+    strict_checks: bool = False,
 ) -> TrainTask:
     """Initialize params, compile the SPMD step, build prefetch loaders.
 
@@ -196,6 +197,19 @@ def prepare_training(
       dummies (the returned task's real state is untouched) before
       returning, so the first ``train`` step — and anything timing it —
       starts warm.
+
+    ``strict_checks=True`` arms the returned step/eval functions for
+    their first TWO invocations: call 1 runs with ``jax_debug_nans`` on
+    (a NaN/Inf in the outputs raises and jax re-runs op-by-op to name
+    the producing primitive), call 2 under
+    ``jax.transfer_guard("disallow")`` (any implicit host↔device
+    transfer raises — the hazard the lint suite's FDT205 check hunts;
+    the guard sits on the steady-state call because step-0 one-time
+    commits are legitimate).  Failures raise with an actionable message
+    naming the offending phase ("first train step" / "steady-state
+    eval step"); subsequent calls run at full speed with both checks
+    off.  Debug-grade: the armed calls also block until the device
+    finishes.
     """
     from ..data.loader import apply_transform
 
@@ -330,7 +344,7 @@ def prepare_training(
         # top-k image metrics can never apply to the LM pipeline; the
         # compiled eval returns loss only
         topk = ()
-        for ax in ("pipe", mesh_lib.DATA_AXIS):
+        for ax in (mesh_lib.PIPE_AXIS, mesh_lib.DATA_AXIS):
             if ax not in mesh.shape:
                 raise ValueError(
                     f"spmd={spmd!r} needs a mesh with 'data' and 'pipe' "
@@ -356,7 +370,7 @@ def prepare_training(
                     "1F1B per-microbatch loss reads tokens only) — use "
                     "spmd='pp', whose loss applies the mask"
                 )
-        S = mesh.shape["pipe"]
+        S = mesh.shape[mesh_lib.PIPE_AXIS]
         n_data = mesh.shape[mesh_lib.DATA_AXIS]
         if num_microbatches is not None and num_microbatches < 1:
             raise ValueError(
@@ -444,7 +458,7 @@ def prepare_training(
             )
         if accum_steps != 1:
             raise ValueError("accum_steps > 1 requires spmd='jit' or 'fsdp'")
-        for ax in ("expert", mesh_lib.DATA_AXIS):
+        for ax in (mesh_lib.EXPERT_AXIS, mesh_lib.DATA_AXIS):
             if ax not in mesh.shape:
                 raise ValueError(
                     "spmd='ep' needs a mesh with 'data' and 'expert' axes, "
@@ -484,7 +498,7 @@ def prepare_training(
             # Ulysses, parallel/context.py) shards the sequence dim over
             # the 'seq' axis inside its own shard_map, and the batch
             # stays data-sharded.  Only the mesh shape needs checking.
-            for ax in ("seq", mesh_lib.DATA_AXIS):
+            for ax in (mesh_lib.SEQ_AXIS, mesh_lib.DATA_AXIS):
                 if ax not in mesh.shape:
                     raise ValueError(
                         "spmd='sp' needs a mesh with 'data' and 'seq' axes, "
@@ -620,7 +634,96 @@ def prepare_training(
                 f"({stats['compile_seconds']:.1f}s of "
                 f"{stats['seconds']:.1f}s) pre-paid before step 0")
 
+    if strict_checks:
+        # a handful of state leaves (the step counter; any scalar the
+        # optimizer creates from literals) are born on one device and
+        # legitimately commit to their replicated sharding at the first
+        # call — do that HERE so the transfer-guarded call only trips on
+        # transfers that would recur every step
+        task.state = _commit_replicated_stragglers(task.state, mesh)
+        task.step_fn = _strict_first_call(task.step_fn, "train step")
+        task.eval_fn = _strict_first_call(task.eval_fn, "eval step")
+
     return task
+
+
+def _commit_replicated_stragglers(state, mesh: Mesh):
+    """Commit any single-device state leaf to the replicated sharding on
+    ``mesh``.  Mode-specific prepare paths device_put their whole state;
+    the plain DP paths leave computation-born scalars (``state.step``)
+    uncommitted, and ``strict_checks`` must not report the one-time
+    step-0 commit of those as a hot-path transfer."""
+    from jax.sharding import NamedSharding, PartitionSpec, SingleDeviceSharding
+
+    if mesh.size <= 1:
+        return state
+    repl = NamedSharding(mesh, PartitionSpec())
+
+    def fix(x):
+        if isinstance(x, jax.Array) and isinstance(x.sharding, SingleDeviceSharding):
+            return jax.device_put(x, repl)
+        return x
+
+    return jax.tree.map(fix, state)
+
+
+def _strict_first_call(fn, phase: str):
+    """``strict_checks`` wrapper: call 1 runs under ``jax_debug_nans``
+    (a NaN/Inf raises and jax re-runs op-by-op to name the producing
+    primitive), call 2 under ``jax.transfer_guard("disallow")`` (any
+    implicit host↔device transfer raises); later calls pass straight
+    through.  The two checks must not share a call: debug-nans' op-by-op
+    re-run itself performs host transfers, so a guard around it would
+    mask the NaN diagnosis with a transfer error.  Putting the guard on
+    call 2 is also the honest check — step-0 one-time commits are
+    legitimate, a transfer on call 2 recurs every step (same protocol as
+    the lint suite's FDT205).  (The wrapper hides a jit object's
+    ``.lower`` — AOT-export a task before arming it with strict
+    checks.)"""
+    stage = {"n": 0}
+
+    def wrapped(*args, **kwargs):
+        n = stage["n"]
+        if n >= 2:
+            return fn(*args, **kwargs)
+        stage["n"] = n + 1
+        if n == 0:
+            old_nans = bool(jax.config.jax_debug_nans)
+            jax.config.update("jax_debug_nans", True)
+            try:
+                out = fn(*args, **kwargs)
+                # surface device-side NaN checks inside the debug
+                # window, not at some later sync point
+                jax.block_until_ready(jax.tree.leaves(out))
+            except FloatingPointError as e:
+                raise FloatingPointError(
+                    f"strict_checks: NaN/Inf produced by the first "
+                    f"{phase} — jax_debug_nans re-ran it op-by-op above "
+                    "to name the producing primitive; check the input "
+                    "batch, init scales and the learning rate"
+                ) from e
+            finally:
+                jax.config.update("jax_debug_nans", old_nans)
+            return out
+        try:
+            with jax.transfer_guard("disallow"):
+                out = fn(*args, **kwargs)
+                jax.block_until_ready(jax.tree.leaves(out))
+        except Exception as e:
+            msg = str(e)
+            if "transfer" in msg.lower():
+                raise RuntimeError(
+                    f"strict_checks: implicit host<->device transfer "
+                    f"during the steady-state {phase}: {msg[:300]} — "
+                    "commit inputs up front (sharding.shard_batch for "
+                    "batches, jax.device_put for state); a transfer here "
+                    "recurs on EVERY step and serializes the dispatch "
+                    "pipeline"
+                ) from e
+            raise
+        return out
+
+    return wrapped
 
 
 def _dummy_batch(dataset, transform, batch_size, mesh, steps_per_call, seed):
@@ -938,7 +1041,10 @@ def train(
         # report exactly the metrics compiled into the task's eval step
         # (loss-only for the LM pipeline modes)
         topk = getattr(task, "topk", (1, 5, 10))
-    t_start = time.time()
+    # perf_counter, not time.time(): the loop's rate/interval math must
+    # be monotonic (NTP steps or DST jumps would corrupt steps/sec and
+    # the span timeline) — lint rule FDT102
+    t_start = time.perf_counter()
     t_mark, j_mark = t_start, 0
     profiling = False
     # device loop: each loader item is K stacked batches = K optimizer
@@ -966,7 +1072,7 @@ def train(
             if batch is _end:
                 break
             if print_every and j % print_every == 0:
-                now = time.time()
+                now = time.perf_counter()
                 if j > j_mark:
                     # interval rates; the loop can only run ahead of the device
                     # by the dispatch queue, so interval averages are accurate

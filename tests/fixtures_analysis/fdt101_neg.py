@@ -1,0 +1,32 @@
+"""FDT101 negative: branches that are static at trace time."""
+from functools import partial
+
+import jax
+
+
+@jax.jit
+def shape_branch(x):
+    if x.shape[0] > 1:  # .shape is static metadata
+        return x
+    return x * 2
+
+
+@partial(jax.jit, static_argnums=(1,))
+def static_branch(x, upscale):
+    if upscale:  # declared static — ordinary Python bool
+        return x * 2
+    return x
+
+
+@jax.jit
+def none_branch(x, y):
+    if y is None:  # identity test, not a value read
+        return x
+    return x + y
+
+
+def host_helper(cfg):
+    # not jit-reachable: plain host code branches freely
+    if cfg:
+        return 1
+    return 0

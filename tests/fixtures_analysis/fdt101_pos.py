@@ -1,0 +1,17 @@
+"""FDT101 positive: Python control flow on a traced parameter's VALUE."""
+import jax
+
+
+@jax.jit
+def relu_branchy(x):
+    if x > 0:  # branches on the tracer — frozen at trace time
+        return x
+    return 0 * x
+
+
+@jax.jit
+def drain(x, steps):
+    while steps > 0:  # tracer-valued loop condition
+        x = x * x
+        steps = steps - 1
+    return x

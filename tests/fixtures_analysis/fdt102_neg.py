@@ -1,0 +1,23 @@
+"""FDT102 negative: pure traced code; monotonic clock in hot paths;
+wall clock only on cold host paths."""
+import time
+
+import jax
+
+
+@jax.jit
+def pure(x):
+    return x * 2
+
+
+def hot_loop(tracer, items):
+    with tracer.span("step"):
+        t0 = time.perf_counter()  # monotonic — the sanctioned clock
+        for _ in items:
+            pass
+        return time.perf_counter() - t0
+
+
+def checkpoint_stamp():
+    # cold path, no span bracket: wall-clock timestamps are fine
+    return time.time()

@@ -1,0 +1,24 @@
+"""FDT102 positive: host impurity in traced code + wall clock in a
+span-bracketed hot path."""
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def stamped(x):
+    return x + time.time()  # baked into the trace as a constant
+
+
+@jax.jit
+def jittered(x):
+    return x + np.random.normal()  # host RNG: one draw, forever
+
+
+def hot_loop(tracer, items):
+    with tracer.span("step"):
+        t0 = time.time()  # wall clock in interval math
+        for _ in items:
+            pass
+        return t0

@@ -1,0 +1,22 @@
+"""FDT103 negative: pinned dtypes and non-literal arguments."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def scaled(x):
+    return x * jnp.array(1.5, dtype=jnp.float32)
+
+
+@jax.jit
+def shifted(x):
+    return x + jnp.array(-3, jnp.int32)  # positional dtype
+
+
+@jax.jit
+def from_arg(x):
+    return jnp.asarray(x)  # not a scalar literal
+
+
+def host_side():
+    return jnp.array(1.5)  # not jit-reachable — eager, no retrace trap

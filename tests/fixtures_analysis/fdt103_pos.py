@@ -1,0 +1,13 @@
+"""FDT103 positive: weak-typed scalar literals in traced code."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def scaled(x):
+    return x * jnp.array(1.5)  # weak f32/f64 — promotion depends on x
+
+
+@jax.jit
+def shifted(x):
+    return x + jnp.asarray(-3)  # weak int

@@ -1,0 +1,22 @@
+"""FDT104 negative: immutable globals and function-local tables."""
+import jax
+
+SCALES = (0.1, 0.2)  # tuple: immutable, snapshot is the value forever
+
+
+@jax.jit
+def scaled(x):
+    return x * SCALES[0]
+
+
+@jax.jit
+def local_table(x):
+    table = {"lr": 0.1}  # local — rebuilt every trace, no stale capture
+    return x * table["lr"]
+
+
+REGISTRY = {}  # mutable, but only host code touches it
+
+
+def register(name, fn):
+    REGISTRY[name] = fn

@@ -1,0 +1,11 @@
+"""FDT104 positive: a traced function reads a mutable module global."""
+import jax
+
+SCALE_TABLE = {"lr": 0.1}
+
+
+@jax.jit
+def scaled(x):
+    # the trace snapshots SCALE_TABLE["lr"] once; later mutation is
+    # silently ignored by every compiled execution
+    return x * SCALE_TABLE["lr"]

@@ -1,0 +1,22 @@
+"""FDT105 negative: axis names sourced from the mesh.py constants."""
+from jax.sharding import PartitionSpec as P
+
+from fluxdistributed_tpu.mesh import DATA_AXIS, PIPE_AXIS
+
+
+def good_spec():
+    return P(DATA_AXIS, None)
+
+
+def shard_over(mesh, batch_axis=DATA_AXIS):
+    return mesh.shape[batch_axis]
+
+
+def stage_count(mesh):
+    return mesh.shape[PIPE_AXIS]
+
+
+def free_string():
+    # a string equal to no declared axis, outside P()/axis positions —
+    # out of the rule's scope entirely
+    return "datalog"

@@ -1,0 +1,21 @@
+"""FDT105 positive: axis-name literals not sourced from mesh.py."""
+from jax.sharding import PartitionSpec as P
+
+
+def bogus_spec():
+    return P("nonexistent_axis")  # unknown axis: GSPMD compile error
+
+
+def hardcoded_spec():
+    return P("data", None)  # declared axis, but a drifting copy
+
+
+def shard_over(mesh, batch_axis="data"):  # hardcoded default
+    return mesh.shape[batch_axis]
+
+
+PIPE_AXIS = "pipe"  # re-declares mesh.py's literal
+
+
+def stage_count(mesh):
+    return mesh.shape["pipe"]  # literal mesh-shape lookup

@@ -1,0 +1,12 @@
+"""FDT106 negative: convention-conforming (or out-of-scope) names."""
+
+
+def _suffix():
+    return "fdtpu_dynamic_total"
+
+
+def register(reg):
+    reg.counter("fdtpu_serve_requests_total")
+    reg.gauge("fdtpu_queue_depth")
+    reg.histogram("fdtpu_train_step_seconds")
+    reg.counter(_suffix())  # non-literal first arg: out of scope

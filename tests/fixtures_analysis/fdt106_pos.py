@@ -1,0 +1,7 @@
+"""FDT106 positive: metric names off the fdtpu_* convention."""
+
+
+def register(reg):
+    reg.counter("serve_requests_total")  # missing prefix
+    reg.gauge("Fdtpu_queue_depth")  # wrong case
+    reg.histogram("fdtpu-step-seconds")  # dashes

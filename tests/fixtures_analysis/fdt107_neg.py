@@ -1,0 +1,22 @@
+"""FDT107 negative: donation declared where documented, or not
+documented at all."""
+import jax
+
+
+def make_toy_step(loss_fn, donate=True):
+    """Build the compiled step.  Donates the incoming state when
+    ``donate=True`` so buffers are updated in place."""
+
+    def step(state, batch):
+        return state
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_copying_step(loss_fn):
+    """Build the compiled step (state copied every call, by design)."""
+
+    def step(state, batch):
+        return state
+
+    return jax.jit(step)

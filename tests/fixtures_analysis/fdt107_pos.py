@@ -1,0 +1,13 @@
+"""FDT107 positive: a step factory documenting donation whose jit calls
+never declare it."""
+import jax
+
+
+def make_toy_step(loss_fn, donate=True):
+    """Build the compiled step.  Donates the incoming state when
+    ``donate=True`` so buffers are updated in place."""
+
+    def step(state, batch):
+        return state
+
+    return jax.jit(step)  # the docstring's promise is never kept

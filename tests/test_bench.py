@@ -105,6 +105,11 @@ def test_main_emits_error_json_and_rc0_on_failure(bench_mod, monkeypatch, capsys
     assert out["phase"] == "unknown"
     assert out["compile_seconds"] == 0.0
     assert out["cache_hits"] == 0 and out["cache_misses"] == 0
+    # the static-health stamp rides the error JSON too: a zero artifact
+    # still records whether the code it ran was lint-clean (shape only —
+    # repo lint cleanliness is bin/lint.py --check's gate, and WIP code
+    # with a finding must not fail an unrelated bench test)
+    assert {"findings", "new", "by_rule"} <= set(out["lint"])
 
     class FakeDone:
         returncode = 1

@@ -116,8 +116,9 @@ def _driver_env():
 @pytest.mark.slow
 def test_driver_obs_flags_end_to_end(tmp_path):
     """--trace-events/--metrics-jsonl/--steady-after plus the cold-start
-    flags (--compile-cache/--aot/--prewarm) through the CLI: artifacts
-    appear and the run completes."""
+    flags (--compile-cache/--aot/--prewarm) and --strict-checks through
+    the CLI: artifacts appear and the run completes (a real training
+    run passes the armed NaN-debug + transfer-guard first steps)."""
     trace = tmp_path / "driver.trace.json"
     jsonl = tmp_path / "driver.jsonl"
     cache = tmp_path / "compile-cache"
@@ -131,6 +132,7 @@ def test_driver_obs_flags_end_to_end(tmp_path):
          "--trace-events", str(trace), "--metrics-jsonl", str(jsonl),
          "--steady-after", "3",
          "--compile-cache", str(cache), "--aot", str(aot), "--prewarm",
+         "--strict-checks",
          "--platform", "cpu", "--local-devices", "8"],
         capture_output=True, text=True, timeout=600, env=_driver_env(),
         cwd=str(REPO),
