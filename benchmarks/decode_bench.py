@@ -27,6 +27,18 @@ Each row also records the engine's compile counts: steady-state decode
 must hold at ONE compiled step program after warmup — a recompile in
 the serving loop is a bug (arXiv:1810.09868's fixed-shape lesson).
 
+A third section compares the two CACHE LAYOUTS (``--layouts``):
+
+3. **paged vs dense** — mid-flight KV HBM bytes per live token (the
+   paged pool allocates blocks as cursors advance, so live bytes track
+   live tokens; dense reserves ``max_slots × max_len`` rows whatever is
+   resident), steady-state decode tok/s under each layout, and the
+   chunked-prefill headline: **TTFT of a short prompt admitted behind a
+   ``max_len``-sized prompt**.  Dense whole-prefill makes the short
+   request wait out the long prompt's entire prefill; paged chunked
+   prefill interleaves, so the short request's first token arrives
+   after a few chunk-sized ticks.
+
     python benchmarks/decode_bench.py --platform cpu     # CPU rows (CI)
     python benchmarks/decode_bench.py --model lm_small --vocab 32000 \
         --prompt-len 128 --new-tokens 256                # TPU session row
@@ -63,6 +75,18 @@ def main():
                          "matmuls ~8x slower — both serving paths use the "
                          "same model, so the comparison stays fair)")
     ap.add_argument("--platform", default=None, help="force platform (e.g. cpu)")
+    ap.add_argument("--layouts", default="dense,paged",
+                    help="cache layouts for the comparison section "
+                         "(comma-separated; 'none' skips it)")
+    ap.add_argument("--kv-block-size", type=int, default=16,
+                    help="paged layout: rows per KV block")
+    ap.add_argument("--kv-blocks", type=int, default=None,
+                    help="paged layout: pool size in blocks per layer "
+                         "(default: full capacity — pass a smaller pool "
+                         "to measure a sub-capacity reserved footprint)")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="paged layout: prompt positions per prefill "
+                         "chunk (default: kv block size x 2)")
     args = ap.parse_args()
 
     import jax
@@ -166,6 +190,103 @@ def main():
             print(f"WARNING: decode step recompiled mid-serve "
                   f"(compiles {compiles_before} -> {compiles_after})",
                   file=sys.stderr)
+
+    # ---- layout comparison: paged vs dense -------------------------------
+    layouts = [l for l in args.layouts.split(",") if l and l != "none"]
+    if not layouts:
+        return
+    chunk = args.prefill_chunk or args.kv_block_size * 2
+    long_len = min(8 * plen, 2048)
+    short_len = max(4, plen // 8)
+    new_cmp = min(new, 32)
+    cap = long_len + new_cmp  # per-slot budget: a max_len-sized prompt
+    ttft = {}
+    for layout in layouts:
+        kw = (dict(layout="paged", kv_block_size=args.kv_block_size,
+                   kv_blocks=args.kv_blocks, prefill_chunk=chunk)
+              if layout == "paged" else
+              dict(buckets=(max(short_len, 16), long_len)))
+        engine = LMEngine(model, params, max_slots=2, max_len=cap, **kw)
+        # warm every program (both prompt shapes) outside the timings
+        warm = Scheduler(engine)
+        warm.generate_all([
+            Request(prompt=list(range(2)), max_new_tokens=2),
+            Request(prompt=list(range(min(long_len, 2 * chunk))),
+                    max_new_tokens=2)])
+        warm.close()
+
+        # TTFT probe: a short prompt admitted BEHIND a max_len-sized
+        # one.  Median of 3 — the TTFTs are small enough that one GC
+        # pause or scheduler hiccup would otherwise dominate the ratio
+        samples = []
+        for _ in range(3):
+            sched = Scheduler(engine, max_queue=4)
+            longp = Request(
+                prompt=list(rng.integers(0, args.vocab, long_len)),
+                max_new_tokens=new_cmp)
+            shortp = Request(
+                prompt=list(rng.integers(0, args.vocab, short_len)),
+                max_new_tokens=new_cmp)
+            sched.submit(longp)
+            sched.submit(shortp)
+            sched.run_until_idle()
+            samples.append(shortp.first_token_at - shortp.submitted_at)
+            sched.close()
+        ttft[layout] = sorted(samples)[1]
+
+        # occupancy probe: mid-flight KV bytes per live token
+        sched = Scheduler(engine, max_queue=4)
+        reqs = [Request(prompt=list(rng.integers(0, args.vocab, plen)),
+                        max_new_tokens=new_cmp) for _ in range(2)]
+        for r in reqs:
+            sched.submit(r)
+        # first-token (not state) is the barrier: with a tiny
+        # --new-tokens a request can already be DONE by the time the
+        # other goes active, and "done" would spin this loop forever
+        while any(r.first_token_at is None for r in reqs):
+            sched.step()
+        for _ in range(4):
+            sched.step()
+        kv = engine.kv_cache_bytes()
+        blocks_now = engine.pool_stats().get("kv_blocks_active")
+        live_tokens = sum(len(r.prompt) + len(r.generated) for r in reqs)
+        sched.run_until_idle()
+        m = sched.metrics()
+        sched.close()
+        print(json.dumps({
+            "metric": f"{args.model} serve cache layout ({platform}, "
+                      f"{jnp.dtype(dtype).name}, layout={layout}, "
+                      f"slots=2, max_len={cap}"
+                      + (f", block={args.kv_block_size}, chunk={chunk}"
+                         if layout == "paged" else "") + ")",
+            "value": round(kv["live"] / live_tokens, 1),
+            "unit": "live KV bytes per live token (mid-flight)",
+            "layout": layout,
+            "kv_bytes_reserved": kv["reserved"],
+            "kv_bytes_live": kv["live"],
+            "live_tokens": live_tokens,
+            "reserved_bytes_per_live_token": round(
+                kv["reserved"] / live_tokens, 1),
+            "steady_decode_tokens_per_sec": round(
+                m["decode_tokens_per_sec"], 2),
+            "short_ttft_behind_long_prompt_ms": round(ttft[layout] * 1e3, 2),
+            "long_prompt_len": long_len,
+            "short_prompt_len": short_len,
+            "decode_compiles": m["decode_compiles"],
+            "prefill_compiles": m["prefill_compiles"],
+            "kv_blocks_total": m.get("kv_blocks_total"),
+            "kv_blocks_active_midflight": blocks_now,
+        }))
+    if "dense" in ttft and "paged" in ttft and ttft["paged"] > 0:
+        print(json.dumps({
+            "metric": f"{args.model} chunked-prefill TTFT win "
+                      f"({platform}: short prompt of {short_len} behind a "
+                      f"{long_len}-token prompt, chunk={chunk})",
+            "value": round(ttft["dense"] / ttft["paged"], 2),
+            "unit": "x shorter TTFT (dense whole-prefill / paged chunked)",
+            "ttft_dense_ms": round(ttft["dense"] * 1e3, 2),
+            "ttft_paged_ms": round(ttft["paged"] * 1e3, 2),
+        }))
 
 
 if __name__ == "__main__":

@@ -78,9 +78,13 @@ fits 2700 && timeout 2700 python benchmarks/attention_bench.py --window 1024 >> 
 
 # serving decode: continuous batching vs sequential generate at
 # C={1,4,16} (CPU rows recorded in docs/benchmarks.md; these are the
-# first TPU rows — lm_small realistic-vocab, then the windowed config)
+# first TPU rows — lm_small realistic-vocab, then the windowed config).
+# Every run also emits the paged-vs-dense layout rows (KV bytes per
+# live token + short-TTFT-behind-long-prompt); the third run sizes a
+# realistic paged pool to put real HBM numbers behind the CPU ratios.
 echo "[$(stamp)] 8/8 decode / serving bench" | tee -a "$OUT/session.log"
 fits 2700 && timeout 2700 python benchmarks/decode_bench.py --model lm_small --vocab 32000 --prompt-len 128 --new-tokens 256 >> "$OUT/decode.jsonl" 2>> "$OUT/session.log"
 fits 2700 && timeout 2700 python benchmarks/decode_bench.py --model lm_small --vocab 32000 --prompt-len 128 --new-tokens 256 --window 1024 --sinks 4 >> "$OUT/decode.jsonl" 2>> "$OUT/session.log"
+fits 2700 && timeout 2700 python benchmarks/decode_bench.py --model lm_small --vocab 32000 --prompt-len 256 --new-tokens 256 --kv-block-size 32 --prefill-chunk 128 --kv-blocks 96 >> "$OUT/decode.jsonl" 2>> "$OUT/session.log"
 
 echo "[$(stamp)] session complete (incl. decode)" | tee -a "$OUT/session.log"

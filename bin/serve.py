@@ -111,6 +111,29 @@ def build_parser():
     p.add_argument("--max-queue", type=int, default=64,
                    help="admission queue bound; beyond it /v1/generate "
                         "returns 429 (backpressure)")
+    # paged KV cache (serve.cache_layout): HBM scales with live tokens
+    p.add_argument("--paged", action="store_true",
+                   help="paged KV cache layout: a shared pool of fixed-"
+                        "size blocks with per-slot page tables instead "
+                        "of worst-case rows per slot; freed blocks "
+                        "return to the pool on EOS (LM mode)")
+    p.add_argument("--kv-block-size", type=int, default=16,
+                   help="rows per KV block (--paged)")
+    p.add_argument("--kv-blocks", type=int, default=None,
+                   help="blocks per layer in the pool (--paged); default "
+                        "sizes for full capacity — set it SMALLER to "
+                        "make HBM scale with live tokens and let "
+                        "admission backpressure cover the tail")
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   help="prompt positions per prefill chunk; chunks "
+                        "interleave with decode ticks so a long prompt "
+                        "cannot spike TTFT for resident requests "
+                        "(--paged defaults to 128; also valid on the "
+                        "dense layout)")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="hash + refcount completed prompt blocks so "
+                        "shared system prompts prefill once "
+                        "(needs --paged, plain attention)")
     p.add_argument("--kv-heads", type=int, default=None,
                    help="match the trainer's --kv-heads (GQA)")
     p.add_argument("--window", type=int, default=None,
@@ -192,7 +215,12 @@ def make_lm_app(args):
     t0 = time.perf_counter()
     engine = LMEngine(model, params, max_slots=args.max_slots,
                       max_len=args.max_len, buckets=buckets,
-                      prewarm=args.prewarm, aot_dir=args.aot_dir)
+                      prewarm=args.prewarm, aot_dir=args.aot_dir,
+                      layout="paged" if args.paged else "dense",
+                      kv_block_size=args.kv_block_size,
+                      kv_blocks=args.kv_blocks,
+                      prefill_chunk=args.prefill_chunk,
+                      prefix_cache=args.prefix_cache)
     if args.prewarm or args.aot_dir:
         print(f"engine ready in {time.perf_counter() - t0:.1f}s "
               f"(compile_stats={engine.compile_stats()})", file=sys.stderr)

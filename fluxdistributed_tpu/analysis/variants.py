@@ -195,8 +195,49 @@ def _build_serve() -> List[StepVariant]:
     return out
 
 
+def _build_serve_paged() -> List[StepVariant]:
+    """The paged-layout engine's program pool: the all-slot decode step,
+    the prefill chunk, and the page-table maintenance programs (bind /
+    release) — with the donation vectors the engine declares.  Page
+    indirection must stay DATA: the jaxpr checks verify the pool's
+    retrace digests are stable, i.e. page-table churn compiles nothing."""
+    import jax
+
+    from ..serve import engine as engine_mod
+
+    model, _ = _lm_setup(depth=1, heads=2)
+    params = model.init(jax.random.PRNGKey(0),
+                        jax.numpy.zeros((1, 8), "int32"), train=False)["params"]
+    eng = engine_mod.LMEngine(model, params, max_slots=2, max_len=64,
+                              layout="paged", kv_block_size=8,
+                              prefill_chunk=16, prefix_cache=True)
+    src = _src(engine_mod)
+    return [
+        StepVariant(name="serve_paged:step", fn=eng._step_jit,
+                    args=eng._example_args("step"),
+                    donate_argnums=(1, 2, 4), mesh=None, source=src,
+                    # (params, cache, tok, temp, keys) → (cache', tok', keys')
+                    carry=lambda a, o: (a[0], o[0], o[1], a[3], o[2])),
+        StepVariant(name="serve_paged:chunk", fn=eng._chunk_jit,
+                    args=eng._example_args("chunk"),
+                    donate_argnums=(1,), mesh=None, source=src,
+                    # (params, cache, toks, slot, start, nvalid, arm) →
+                    #     (cache', last_logits)
+                    carry=lambda a, o: (a[0], o[0]) + a[2:]),
+        StepVariant(name="serve_paged:bind", fn=eng._bind_jit,
+                    args=eng._example_args("bind"),
+                    donate_argnums=(0,), mesh=None, source=src,
+                    # (cache, slot, page_row) → cache'
+                    carry=lambda a, o: (o,) + a[1:]),
+        StepVariant(name="serve_paged:release", fn=eng._release_jit,
+                    args=eng._example_args("release"),
+                    donate_argnums=(0,), mesh=None, source=src,
+                    carry=lambda a, o: (o,) + a[1:]),
+    ]
+
+
 #: name → builder; the six parallelism variants the acceptance gate
-#: names, plus the serve engine's program pool
+#: names, plus the serve engine's program pools (dense and paged)
 VARIANT_BUILDERS: Dict[str, Callable[[], List[StepVariant]]] = {
     "dp": _build_dp,
     "zero1": _build_zero1,
@@ -205,6 +246,7 @@ VARIANT_BUILDERS: Dict[str, Callable[[], List[StepVariant]]] = {
     "pp_1f1b": _build_pp_1f1b,
     "context": _build_context,
     "serve": _build_serve,
+    "serve_paged": _build_serve_paged,
 }
 
 

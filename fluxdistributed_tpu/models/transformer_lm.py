@@ -132,6 +132,18 @@ class CausalSelfAttention(nn.Module):
     # untouched — a larger ring only RETAINS more, and retained
     # out-of-band keys are mask-excluded anyway.
     ring_slack: int = 0
+    # paged KV cache (serve/engine.py layout="paged"): instead of one
+    # contiguous [B, rows] cache per layer, K/V live in a shared pool of
+    # ``kv_blocks`` fixed-size blocks ([blocks, kv_block_size, hkv, dh])
+    # and each batch row carries a page table of int32 block ids.  The
+    # indirection is DATA, never shape (arXiv:1810.09868's full-program
+    # lesson): page-table updates feed the same compiled program, so
+    # HBM scales with live tokens while the ONE-decode-compile invariant
+    # holds.  A -1 page-table entry means "unallocated": reads through
+    # it are mask-excluded, writes are dropped — which is also what
+    # parks a freed slot safely.  0 = dense (the default layout).
+    kv_block_size: int = 0
+    kv_blocks: int = 0
 
     @nn.compact
     def __call__(self, x):
@@ -166,6 +178,15 @@ class CausalSelfAttention(nn.Module):
             )
         if self.window is not None and self.window < 1:
             raise ValueError(f"window must be >= 1, got {self.window}")
+        if (self.kv_block_size > 0) != (self.kv_blocks > 0):
+            raise ValueError(
+                f"paged KV needs BOTH kv_block_size ({self.kv_block_size}) "
+                f"and kv_blocks ({self.kv_blocks}) positive (or both 0 for "
+                "the dense layout)")
+        if self.kv_block_size and not self.decode:
+            raise ValueError(
+                "kv_block_size > 0 (paged KV) is a layout OF the decode "
+                "cache; build the model with decode=True")
         head_dim = d // self.num_heads
         hkv = self.num_kv_heads or self.num_heads
         if self.num_heads % hkv:
@@ -193,7 +214,145 @@ class CausalSelfAttention(nn.Module):
             )(x)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
-        if self.decode:
+        if self.decode and self.kv_block_size:
+            # ---- paged block-pool KV layout -----------------------------
+            # K/V live in a shared pool of fixed-size blocks; each batch
+            # row carries a page table of int32 block ids (-1 =
+            # unallocated: reads masked, writes dropped).  ONE code path
+            # serves any (B, t): the all-slot decode step (B=max_slots,
+            # t=1) and batch-1 (chunked) prefill are the same program at
+            # different argument shapes — every row advances from its own
+            # cursor, writes route through its page-table row, reads
+            # gather the row's pages back into a contiguous view.  The
+            # indirection is carried as DATA, so page-table churn never
+            # retraces a compiled program.
+            is_init = not self.has_variable("cache", "cached_k")
+            cache_len = (
+                t if self.window is None
+                else min(self.window + self.sinks + self.ring_slack, t)
+            )
+            bs_kv = self.kv_block_size
+            pages = -(-cache_len // bs_kv)
+            r_pad = pages * bs_kv
+            cached_k = self.variable(
+                "cache", "cached_k", jnp.zeros,
+                (self.kv_blocks, bs_kv, hkv, head_dim), k.dtype,
+            )
+            cached_v = self.variable(
+                "cache", "cached_v", jnp.zeros,
+                (self.kv_blocks, bs_kv, hkv, head_dim), v.dtype,
+            )
+            cache_index = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((b,), jnp.int32))
+            page_table = self.variable(
+                "cache", "page_table",
+                lambda: jnp.full((b, pages), -1, jnp.int32))
+            # per-row write gate: 0 = parked or mid-prefill, 1 = live.
+            # The all-slot decode step rides EVERY row and drifts the
+            # cursors of rows it does not own; a mid-prefill row has
+            # bound pages (claimed prefix blocks, earlier chunks), so
+            # unlike a parked row its drift writes would LAND — into a
+            # shared prefix block, or over a windowed ring's in-band
+            # keys once the drift outruns the ring slack.  Gating the
+            # write on slot_live (the chunk program runs its batch-1
+            # view with the gate forced open) makes any decode/prefill
+            # interleaving safe; the gate is cache DATA, so flipping it
+            # never retraces.
+            slot_live = self.variable(
+                "cache", "slot_live", lambda: jnp.zeros((b,), jnp.int32))
+            slot_pos = None
+            if self.window is not None:
+                slot_pos = self.variable(
+                    "cache", "slot_pos",
+                    lambda: jnp.full((b, r_pad), -1, jnp.int32))
+            if not is_init:
+                # post-init, t is a CHUNK length (1 for the decode step);
+                # the page count is fixed by the stored table, not by t
+                pages = page_table.value.shape[1]
+                r_pad = pages * bs_kv
+                idx = cache_index.value  # [B] per-row cursors
+                wpos = idx[:, None] + jnp.arange(t)[None, :]  # [B, T]
+                if self.use_rope:
+                    q, k = rope(q, wpos), rope(k, wpos)
+                pt = page_table.value  # [B, pages]
+                rows = jnp.arange(b)[:, None]  # [B, 1]
+                live = slot_live.value[:, None] > 0  # [B, 1] write gate
+
+                def gather_view(pool):
+                    # -1 ("unallocated") clamps to block 0 purely to
+                    # keep the gather in bounds; every such row is
+                    # mask-excluded below
+                    g = pool[jnp.maximum(pt, 0)]
+                    return g.reshape(b, r_pad, hkv, head_dim)
+
+                if self.window is None:
+                    # logical row == global position.  Write first,
+                    # gather after: the chunk's own keys must be in the
+                    # attendable view (the dense prefill path's
+                    # write-then-read order).
+                    keep = (wpos < r_pad) & live  # live rows, in range
+                    page = jnp.minimum(wpos // bs_kv, pages - 1)
+                    phys = pt[rows, page]
+                    phys = jnp.where(keep & (phys >= 0), phys,
+                                     self.kv_blocks)
+                    off = wpos % bs_kv
+                    cached_k.value = cached_k.value.at[phys, off].set(
+                        k, mode="drop")
+                    cached_v.value = cached_v.value.at[phys, off].set(
+                        v, mode="drop")
+                    attn_k = gather_view(cached_k.value)
+                    attn_v = gather_view(cached_v.value)
+                    allow = (jnp.arange(r_pad)[None, None, :]
+                             <= wpos[:, :, None])  # [B, T, keys]
+                else:
+                    # read [pages ∥ this chunk] BEFORE the rolling write
+                    # — the dense ring's order, so a key this chunk
+                    # evicts stays attendable for its own earlier queries
+                    attn_k = jnp.concatenate(
+                        [gather_view(cached_k.value), k], axis=1)
+                    attn_v = jnp.concatenate(
+                        [gather_view(cached_v.value), v], axis=1)
+                    sp = jnp.concatenate(
+                        [slot_pos.value, wpos], axis=1)[:, None, :]
+                    qg = wpos[:, :, None]  # [B, T, 1]
+                    allow = (sp >= 0) & (sp <= qg)
+                    in_band = sp > qg - self.window
+                    if self.sinks:
+                        in_band |= sp < self.sinks
+                    allow &= in_band
+                    # the logical ring spans ALL paged rows: rounding
+                    # cache_len up to a block multiple only RETAINS
+                    # more, and retained out-of-band keys are
+                    # mask-excluded anyway (the ring_slack argument)
+                    ring = max(r_pad - self.sinks, 1)
+                    keep = wpos > idx[:, None] + t - 1 - ring
+                    if self.sinks:
+                        keep |= wpos < self.sinks
+                        ring_slot = self.sinks + (wpos - self.sinks) % ring
+                        lrow = jnp.where(wpos < self.sinks, wpos, ring_slot)
+                    else:
+                        lrow = wpos % ring
+                    keep &= live  # mid-prefill/parked rows never write
+                    phys = pt[rows, lrow // bs_kv]
+                    phys = jnp.where(keep & (phys >= 0), phys,
+                                     self.kv_blocks)
+                    off = lrow % bs_kv
+                    cached_k.value = cached_k.value.at[phys, off].set(
+                        k, mode="drop")
+                    cached_v.value = cached_v.value.at[phys, off].set(
+                        v, mode="drop")
+                    slot_pos.value = slot_pos.value.at[
+                        rows, jnp.where(keep, lrow, r_pad)].set(
+                        wpos, mode="drop")
+                cache_index.value = idx + t
+                out = dot_product_attention(
+                    q, attn_k, attn_v, mask=allow[:, None])
+                return nn.DenseGeneral(
+                    d, axis=(-2, -1), dtype=self.dtype, name="out"
+                )(out)
+            # fall through at init: trace the normal full-length path so
+            # every param/cache shape is fixed
+        elif self.decode:
             is_init = not self.has_variable("cache", "cached_k")
             # at init, t is the FULL target length -> static cache shape.
             # With a window the cache is `sinks` PINNED slots plus a
@@ -384,6 +543,8 @@ class DecoderBlock(nn.Module):
     norm_eps: float = 1e-6
     slot_decode: bool = False
     ring_slack: int = 0
+    kv_block_size: int = 0
+    kv_blocks: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -395,7 +556,8 @@ class DecoderBlock(nn.Module):
             use_rope=self.use_rope, decode=self.decode,
             num_kv_heads=self.num_kv_heads, window=self.window,
             sinks=self.sinks, slot_decode=self.slot_decode,
-            ring_slack=self.ring_slack,
+            ring_slack=self.ring_slack, kv_block_size=self.kv_block_size,
+            kv_blocks=self.kv_blocks,
         )(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -451,6 +613,8 @@ class MoEDecoderBlock(nn.Module):
     norm_eps: float = 1e-6
     slot_decode: bool = False
     ring_slack: int = 0
+    kv_block_size: int = 0
+    kv_blocks: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -460,7 +624,8 @@ class MoEDecoderBlock(nn.Module):
             use_rope=self.use_rope, decode=self.decode,
             num_kv_heads=self.num_kv_heads, window=self.window,
             sinks=self.sinks, slot_decode=self.slot_decode,
-            ring_slack=self.ring_slack,
+            ring_slack=self.ring_slack, kv_block_size=self.kv_block_size,
+            kv_blocks=self.kv_blocks,
         )(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -516,6 +681,12 @@ class TransformerLM(nn.Module):
     # extra windowed-ring KV capacity so bucket-padded prefill cannot
     # evict in-band keys (see CausalSelfAttention.ring_slack)
     ring_slack: int = 0
+    # paged KV cache (serve/engine.py layout="paged"): per-layer K/V in
+    # a shared pool of kv_blocks fixed-size blocks, indexed through a
+    # per-row page table carried as device data (see
+    # CausalSelfAttention.kv_block_size).  0/0 = dense layout.
+    kv_block_size: int = 0
+    kv_blocks: int = 0
     num_kv_heads: Optional[int] = None  # GQA: grouped KV heads
     window: Optional[int] = None  # sliding-window attention
     sinks: int = 0  # StreamingLLM attention sinks (with window)
@@ -570,16 +741,21 @@ class TransformerLM(nn.Module):
                 )
                 if not self.is_initializing():
                     if self.slot_decode:
-                        if t != 1:
+                        if t != 1 and not self.kv_block_size:
                             raise ValueError(
                                 "slot_decode with use_rope=False steps one "
                                 f"token per slot (t=1), got t={t}")
-                        # gather clamps parked slots past the table end —
-                        # their output is discarded by the engine anyway
+                        # each row reads its own t rows of the table from
+                        # its cursor (t=1 for the decode step; paged
+                        # chunked prefill feeds t=chunk).  The gather
+                        # clamps parked slots past the table end — their
+                        # output is discarded by the engine anyway
+                        pos = (pos_index.value[:, None]
+                               + jnp.arange(t)[None, :])  # [B, T]
                         rows = jnp.take(
-                            jnp.asarray(pos_tab), pos_index.value, axis=0
-                        )[:, None, :]  # [B, 1, dim]
-                        pos_index.value = pos_index.value + 1
+                            jnp.asarray(pos_tab), pos, axis=0
+                        )  # [B, T, dim]
+                        pos_index.value = pos_index.value + t
                         x = x + jnp.asarray(rows, self.dtype)
                     else:
                         rows = jax.lax.dynamic_slice(
@@ -625,6 +801,7 @@ class TransformerLM(nn.Module):
                     window=self.window, sinks=self.sinks, norm=self.norm,
                     norm_eps=self.norm_eps, name=f"block{i}",
                     slot_decode=self.slot_decode, ring_slack=self.ring_slack,
+                    kv_block_size=self.kv_block_size, kv_blocks=self.kv_blocks,
                 )(x, train)
             else:
                 x = block_cls(
@@ -635,6 +812,7 @@ class TransformerLM(nn.Module):
                     sinks=self.sinks, norm=self.norm, mlp=self.mlp,
                     norm_eps=self.norm_eps, name=f"block{i}",
                     slot_decode=self.slot_decode, ring_slack=self.ring_slack,
+                    kv_block_size=self.kv_block_size, kv_blocks=self.kv_blocks,
                 )(x, train)
         x = _norm_layer(self.norm, self.dtype, name="final_ln", eps=self.norm_eps)(x)
         if self.tie_embeddings:
@@ -712,7 +890,9 @@ def make_decode_cache(model: TransformerLM, batch: int, total_len: int):
 
     def _cache_leaf(path, s):
         name = getattr(path[-1], "key", None)
-        if name == "slot_pos":
+        # -1 sentinels: slot_pos ("unwritten, never attendable") and the
+        # paged page_table ("unallocated: reads masked, writes dropped")
+        if name in ("slot_pos", "page_table"):
             return jnp.full(s.shape, -1, s.dtype)
         return jnp.zeros(s.shape, s.dtype)
 
@@ -748,6 +928,12 @@ def generate(
     """
     if not model.decode:
         raise ValueError("generate() needs a model built with decode=True")
+    if model.kv_block_size:
+        raise ValueError(
+            "generate() decodes through the dense contiguous cache; paged "
+            "KV (kv_block_size > 0) is the serving engine's layout — drop "
+            "kv_block_size/kv_blocks here, or serve through "
+            "serve.LMEngine(layout='paged')")
     if not model.use_rope:
         # learned positions decode via the pos_index cursor — but the
         # table is finite, and dynamic_slice would silently CLAMP past

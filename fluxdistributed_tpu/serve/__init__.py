@@ -1,16 +1,22 @@
 """Continuous-batching LM inference (the serving half of the north
-star): slot-based KV cache engine, prefill/decode scheduler, and a
-streaming HTTP front end — all requests flow through two compiled XLA
-programs (bucketed prefill + fixed-slot decode)."""
+star): slot-based KV cache engine (dense fixed slots or a paged KV
+block pool with chunked prefill and prefix reuse), prefill/decode
+scheduler, and a streaming HTTP front end — all requests flow through
+a fixed pool of compiled XLA programs."""
 
-from .engine import DEFAULT_BUCKETS, LMEngine
+from .cache_layout import BlockPool, DenseLayout, PagedLayout
+from .engine import DEFAULT_BUCKETS, DEFAULT_KV_BLOCK_SIZE, LMEngine
 from .scheduler import QueueFull, Request, Scheduler
 from .server import LMServer, serve_lm
 
 __all__ = [
+    "BlockPool",
     "DEFAULT_BUCKETS",
+    "DEFAULT_KV_BLOCK_SIZE",
+    "DenseLayout",
     "LMEngine",
     "LMServer",
+    "PagedLayout",
     "QueueFull",
     "Request",
     "Scheduler",

@@ -4,45 +4,68 @@ The ROADMAP's inference half ("serve heavy traffic") needs many
 concurrent requests per chip, but per-request Python loops throw away
 exactly what makes TPUs fast: a small set of fixed-shape compiled XLA
 programs (arXiv:1810.09868's core lesson).  This engine serves ANY
-number of requests through exactly two jitted programs plus a splice:
+number of requests through a handful of fixed-shape programs:
 
-* **Bucketed prefill** — a batch-1 scalar-index decode forward over the
-  prompt padded up to a shape bucket ({128, 512, 2048} by default), so
-  the jit cache holds one compiled prefill per bucket and stays warm no
-  matter what prompt lengths arrive.  Right-padding is safe by
-  construction: a position's cache slot is a function of the position
-  alone, the causal mask admits only positions ≤ the query's, and every
-  pad entry is overwritten by the real token for its position before it
-  could ever become attendable.
+* **Bucketed prefill** (dense layout) — a batch-1 scalar-index decode
+  forward over the prompt padded up to a shape bucket ({128, 512, 2048}
+  by default), so the jit cache holds one compiled prefill per bucket
+  and stays warm no matter what prompt lengths arrive.  Right-padding
+  is safe by construction: a position's cache slot is a function of the
+  position alone, the causal mask admits only positions ≤ the query's,
+  and every pad entry is overwritten by the real token for its position
+  before it could ever become attendable.
 * **Fixed-slot decode** — ONE single-token step over all ``max_slots``
   cache rows of a ``slot_decode=True`` model (per-slot cursors, see
   models/transformer_lm.py), compiled once.  Finished requests free
   their slot; admissions splice a prefilled batch-1 cache into a free
   row mid-flight without touching the compiled step.
 
-The slot cache layout is the model's own: ``max_slots × (sinks + window
-| max_len)`` per layer, ring-buffer + pinned sinks when windowed.
+Two **cache layouts** (``serve/cache_layout.py``) sit under those
+programs:
+
+* ``layout="dense"`` (default) — the original fixed-slot cache:
+  ``max_slots × (sinks + window + slack | max_len)`` rows per layer,
+  ring-buffer + pinned sinks when windowed.  HBM scales with capacity.
+* ``layout="paged"`` — a shared pool of ``kv_blocks`` fixed-size KV
+  blocks per layer with per-slot page tables carried as device-side
+  int32 *data*, so HBM scales with live tokens and freed blocks return
+  to the pool on EOS.  Prefill runs in fixed-size **chunks** written
+  straight through the page table (no splice program), which lets the
+  scheduler interleave a long prompt's chunks with decode ticks; with
+  ``prefix_cache=True`` completed prompt blocks are hash-keyed and
+  refcounted so shared prefixes prefill once.  Page-table updates are
+  data fed to the same compiled programs — the ONE-decode-compile
+  invariant holds across admissions, frees, growth and prefix reuse.
+
 Greedy decoding is token-for-token identical to sequential
-:func:`models.generate` (the golden parity test,
-tests/test_serve_engine.py); temperature sampling uses an independent
-per-request key stream (``fold``-free: keys split inside the compiled
-step), so it is distribution-identical but not key-stream-identical.
+:func:`models.generate` under BOTH layouts (the golden parity tests,
+tests/test_serve_engine.py and tests/test_serve_paged.py); temperature
+sampling uses an independent per-request key stream (``fold``-free:
+keys split inside the compiled step), so it is distribution-identical
+but not key-stream-identical.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..models.transformer_lm import TransformerLM, make_decode_cache
+from .cache_layout import DenseLayout, PagedLayout
 
-__all__ = ["LMEngine", "DEFAULT_BUCKETS"]
+__all__ = ["LMEngine", "DEFAULT_BUCKETS", "DEFAULT_KV_BLOCK_SIZE"]
 
 DEFAULT_BUCKETS = (128, 512, 2048)
+DEFAULT_KV_BLOCK_SIZE = 16
+
+#: cache leaves that carry one row per slot (everything else is a
+#: shared block pool in the paged layout)
+_PER_ROW_LEAVES = ("cache_index", "pos_index", "page_table", "slot_pos",
+                   "slot_live")
 
 
 def _jit_cache_size(fn) -> int:
@@ -55,6 +78,28 @@ def _jit_cache_size(fn) -> int:
         return -1
 
 
+def _leaf_name(path) -> Optional[str]:
+    return getattr(path[-1], "key", None)
+
+
+class _PrefillState:
+    """In-flight prefill for one slot — the scheduler advances it one
+    chunk per call so a long prompt interleaves with decode ticks."""
+
+    __slots__ = ("slot", "tokens", "temperature", "key", "plen", "pos",
+                 "small", "padded")
+
+    def __init__(self, slot, tokens, temperature, key, pos=0, small=None):
+        self.slot = slot
+        self.tokens = [int(t) for t in tokens]
+        self.temperature = float(temperature)
+        self.key = key
+        self.plen = len(self.tokens)
+        self.pos = pos        # next prompt position to process
+        self.small = small    # dense layout: carried batch-1 cache
+        self.padded = 0       # padded tokens computed so far
+
+
 class LMEngine:
     """Compiled-program pool + slot cache for continuous batching.
 
@@ -64,12 +109,30 @@ class LMEngine:
     all calls onto one loop thread.
 
     Cold start (:mod:`fluxdistributed_tpu.compilation`): ``prewarm=True``
-    runs :meth:`warmup` at construction — every bucket's prefill, the
-    splice and the all-slot decode step compile before the first request
-    instead of inside its latency.  ``aot_dir`` goes further: each
-    program is loaded from a serialized on-disk executable when one
-    matches this topology + model, else compiled now and serialized for
-    the next process (a restarted server skips its whole compile pool).
+    runs :meth:`warmup` at construction — every program compiles before
+    the first request instead of inside its latency.  ``aot_dir`` goes
+    further: each program is loaded from a serialized on-disk executable
+    when one matches this topology + model, else compiled now and
+    serialized for the next process (a restarted server skips its whole
+    compile pool).
+
+    Layout knobs:
+
+    * ``layout`` — ``"dense"`` (default, the original fixed-slot cache)
+      or ``"paged"`` (shared KV block pool + per-slot page tables).
+    * ``kv_block_size`` / ``kv_blocks`` — paged pool geometry: rows per
+      block and blocks per layer.  ``kv_blocks=None`` sizes the pool for
+      full capacity (``max_slots`` worst-case slots — no memory saving,
+      but never refuses what dense would serve); size it SMALLER to make
+      HBM scale with live tokens and let admission backpressure handle
+      the tail.
+    * ``prefill_chunk`` — prompt positions per prefill chunk.  Paged
+      prefill is always chunked (default 128); a dense engine stays on
+      whole-bucket prefill unless a chunk size is given.
+    * ``prefix_cache`` — paged only, plain attention only: completed
+      prompt blocks are prefix-hash-keyed and refcounted, so repeated
+      system prompts prefill once (copy-on-write at the divergence
+      block — shared blocks are never written).
     """
 
     def __init__(
@@ -82,6 +145,11 @@ class LMEngine:
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         prewarm: bool = False,
         aot_dir: str | None = None,
+        layout: str = "dense",
+        kv_block_size: int = DEFAULT_KV_BLOCK_SIZE,
+        kv_blocks: int | None = None,
+        prefill_chunk: int | None = None,
+        prefix_cache: bool = False,
     ):
         if model.moe_every:
             raise ValueError(
@@ -92,20 +160,48 @@ class LMEngine:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if max_len < 2:
             raise ValueError(f"max_len must be >= 2, got {max_len}")
+        if layout not in ("dense", "paged"):
+            raise ValueError(f"unknown cache layout {layout!r} (dense|paged)")
         if not model.use_rope:
             if model.max_len is None or model.max_len < max_len:
                 raise ValueError(
                     f"use_rope=False needs the model's learned positional "
                     f"table to cover the engine's max_len ({max_len}); got "
                     f"model.max_len={model.max_len}")
-        # clamp buckets to the cache and always top out AT max_len:
-        # without the top bucket, a prompt in (largest bucket, max_len]
-        # would be rejected even though the slot cache can hold it
-        bl = sorted({int(b) for b in buckets if 0 < int(b) < max_len}
-                    | {max_len})
-        self.buckets: Tuple[int, ...] = tuple(bl)
+        if prefix_cache and layout != "paged":
+            raise ValueError(
+                "prefix_cache=True needs layout='paged' (the dense layout "
+                "has no shareable blocks)")
+        if prefix_cache and model.window is not None:
+            raise ValueError(
+                "prefix_cache is not supported with sliding-window "
+                "attention: ring eviction makes a stored block's contents "
+                "depend on everything decoded after it, so equal prefixes "
+                "stop implying equal blocks. Drop window= or prefix_cache.")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        self.layout_name = layout
         self.max_slots = max_slots
         self.max_len = max_len
+        if layout == "paged":
+            self.prefill_chunk: Optional[int] = min(
+                prefill_chunk or 128, max_len)
+            self.buckets: Tuple[int, ...] = ()
+        else:
+            self.prefill_chunk = (
+                min(prefill_chunk, max_len) if prefill_chunk else None)
+            # clamp buckets to the cache and always top out AT max_len:
+            # without the top bucket, a prompt in (largest bucket,
+            # max_len] would be rejected even though the slot cache can
+            # hold it
+            bl = sorted({int(b) for b in buckets if 0 < int(b) < max_len}
+                        | {max_len})
+            self.buckets = tuple(bl)
+        #: chunked prefill (paged always; dense with prefill_chunk=)
+        #: advances through prefill_begin/prefill_step — the scheduler
+        #: interleaves chunks with decode ticks
+        self.prefill_incremental = self.prefill_chunk is not None
         # store weights in the model's COMPUTE dtype once, up front.
         # flax casts f32-stored params to `dtype` inside every apply;
         # generate()'s scan hoists that cast out of its loop, but the
@@ -122,42 +218,70 @@ class LMEngine:
         # decode=True rejects attn_fn by design (the cache path always
         # uses the dense core — the math is identical for gathered
         # weights); dropout is inference-irrelevant.  ring_slack sizes
-        # the windowed ring so BUCKET-PADDED prefill can never evict an
-        # in-band real key (pad writes land beyond every real position's
-        # reach); _insert then scrubs the pad entries themselves.  The
-        # slack needed is the largest possible PAD RUN: a prompt padded
-        # to its smallest covering bucket pads by less than the gap to
-        # the previous bucket — so dense buckets keep windowed slot
-        # caches near sinks+window instead of max_len.
+        # the windowed ring so PADDED prefill can never evict an in-band
+        # real key (pad writes land beyond every real position's reach);
+        # the splice/chunk writeback then scrubs the pad entries
+        # themselves.  The slack needed is the largest possible PAD RUN:
+        # bucketed prefill pads by less than the gap to the previous
+        # bucket; chunked prefill pads only the final chunk, by less
+        # than the chunk size.
         if model.window is not None:
-            gaps = [self.buckets[0]] + [
-                b - a for a, b in zip(self.buckets, self.buckets[1:])]
+            if self.buckets:
+                gaps = [self.buckets[0]] + [
+                    b - a for a, b in zip(self.buckets, self.buckets[1:])]
+            else:
+                gaps = []
+            if self.prefill_chunk:
+                gaps.append(self.prefill_chunk)
             slack = max(gaps)
         else:
             slack = 0
-        #: per-slot per-layer KV rows actually allocated.  For windowed
-        #: models this is sinks+window+slack (slack = largest bucket
-        #: gap), NOT sinks+window: sparse buckets inflate it.  Pass a
-        #: denser bucket ladder to tighten the bound toward the window.
+        #: per-slot per-layer KV rows logically addressable.  For
+        #: windowed models this is sinks+window+slack (slack = largest
+        #: pad run), NOT sinks+window: sparse buckets inflate it.  Pass
+        #: a denser bucket ladder (or a smaller prefill chunk) to
+        #: tighten the bound toward the window.
         self.kv_rows_per_slot = (
             max_len if model.window is None
             else min(model.window + model.sinks + slack, max_len))
+        if layout == "paged":
+            pages_per_slot = -(-self.kv_rows_per_slot // kv_block_size)
+            if kv_blocks is None:
+                kv_blocks = max_slots * pages_per_slot
+            self.layout = PagedLayout(
+                max_slots, self.kv_rows_per_slot, kv_block_size,
+                kv_blocks, prefix_cache=prefix_cache)
+            paged_kw = dict(kv_block_size=kv_block_size, kv_blocks=kv_blocks)
+        else:
+            self.layout = DenseLayout(max_slots, self.kv_rows_per_slot)
+            paged_kw = dict()
         self.decode_model = model.clone(
             decode=True, slot_decode=True, attn_fn=None, dropout=0.0,
-            ring_slack=slack)
-        self.prefill_model = model.clone(
-            decode=True, slot_decode=False, attn_fn=None, dropout=0.0,
-            ring_slack=slack)
+            ring_slack=slack, **paged_kw)
         self.cache = make_decode_cache(self.decode_model, max_slots, max_len)
-        # reusable zero template: _prefill never mutates its input, so
-        # one template serves every admission
-        self._prefill_zero = make_decode_cache(self.prefill_model, 1, max_len)
+        if layout == "dense":
+            self.prefill_model = model.clone(
+                decode=True, slot_decode=False, attn_fn=None, dropout=0.0,
+                ring_slack=slack)
+            # reusable zero template: _prefill never mutates its input,
+            # so one template serves every admission
+            self._prefill_zero = make_decode_cache(
+                self.prefill_model, 1, max_len)
+        else:
+            # paged prefill is the decode model itself at chunk shape —
+            # chunks write straight through the page table, no splice
+            self.prefill_model = None
+            self._prefill_zero = None
         # per-slot sampling state lives ON DEVICE between steps — the
         # decode loop's only host traffic is the one token sync the
         # scheduler needs for stop checks and streaming
         self._tok = jnp.zeros((max_slots,), jnp.int32)
         self._temp = jnp.zeros((max_slots,), jnp.float32)
         self._keys = jnp.zeros((max_slots, 2), jnp.uint32)
+        # paged host mirrors: which slots are decoding, and each slot's
+        # next write position (drives just-in-time block allocation)
+        self._decoding: set = set()
+        self._host_pos = [0] * max_slots
         self._prefill_jit = jax.jit(self._prefill_impl)
         # donate the carried state (slot cache, tokens, keys): every
         # step/splice REPLACES them, so XLA may update the KV in place
@@ -167,6 +291,9 @@ class LMEngine:
         self._insert_jit = jax.jit(self._insert_impl, donate_argnums=(0,))
         self._step_jit = jax.jit(self._step_impl, donate_argnums=(1, 2, 4))
         self._sample1_jit = jax.jit(self._sample)
+        self._chunk_jit = jax.jit(self._chunk_impl, donate_argnums=(1,))
+        self._bind_jit = jax.jit(self._bind_impl, donate_argnums=(0,))
+        self._release_jit = jax.jit(self._release_impl, donate_argnums=(0,))
         # AOT executables keyed by program name (prefill additionally by
         # bucket — one fixed shape each); populated by _load_aot, empty
         # when aot_dir is None so every call falls through to the jits
@@ -179,9 +306,9 @@ class LMEngine:
     # ---- compiled programs ------------------------------------------------
 
     def _prefill_impl(self, params, cache0, toks, plen):
-        """Whole padded prompt in one parallel pass; returns the filled
-        batch-1 cache and the logits at the LAST REAL position (the
-        distribution of the first generated token)."""
+        """Whole padded prompt (or one chunk of it) in one parallel
+        pass; returns the filled batch-1 cache and the logits at the
+        LAST REAL position (the distribution of the next token)."""
         logits, mut = self.prefill_model.apply(
             {"params": params, "cache": cache0}, toks, train=False,
             mutable=["cache"],
@@ -199,7 +326,7 @@ class LMEngine:
         """
 
         def leaf(path, bg, sm):
-            name = getattr(path[-1], "key", None)
+            name = _leaf_name(path)
             if name in ("cache_index", "pos_index"):
                 return bg.at[slot].set(jnp.asarray(plen, bg.dtype))
             if name == "slot_pos":
@@ -213,6 +340,99 @@ class LMEngine:
             raise ValueError(f"unknown cache leaf {name!r}")
 
         return jax.tree_util.tree_map_with_path(leaf, big, small)
+
+    def _chunk_impl(self, params, cache, toks, slot, start, nvalid, arm):
+        """One paged prefill chunk straight into slot ``slot``'s pages.
+
+        A batch-1 view of the slot's rows (shared pools pass through
+        untouched) runs the decode model at chunk shape; the writeback
+        then pins the cursors to ``start + nvalid`` (host truth — the
+        all-slot decode step may have drifted a mid-prefill slot's
+        cursor, and a padded final chunk overshoots) and scrubs pad
+        ``slot_pos`` entries, exactly the dense splice's invariant.
+        The view forces the ``slot_live`` write gate open (the big
+        cache keeps it 0 mid-prefill so decode-tick drift writes DROP);
+        ``arm=1`` on the final chunk flips the big gate live for
+        decode.  Page tables are read-only here: allocation is host
+        bookkeeping applied through :meth:`_bind_impl`, all of it DATA
+        — this one compiled program serves every chunk of every
+        prompt."""
+
+        def take(path, leaf):
+            name = _leaf_name(path)
+            if name in _PER_ROW_LEAVES:
+                row = jax.lax.dynamic_slice_in_dim(leaf, slot, 1, axis=0)
+                if name in ("cache_index", "pos_index"):
+                    row = jnp.full_like(row, start)
+                if name == "slot_live":
+                    row = jnp.ones_like(row)  # the chunk itself writes
+                if name == "slot_pos":
+                    # every ring entry >= start is cursor-drift garbage
+                    # from before the slot_live gate existed for this
+                    # row (e.g. a fresh admission over a just-released
+                    # slot) — scrub with host truth so the windowed
+                    # read-before-write can never see a position this
+                    # slot has not actually written
+                    row = jnp.where(row < start, row, -1)
+                return row
+            return leaf  # shared block pools
+
+        view = jax.tree_util.tree_map_with_path(take, cache)
+        logits, mut = self.decode_model.apply(
+            {"params": params, "cache": view}, toks, train=False,
+            mutable=["cache"],
+        )
+        new = mut["cache"]
+        end = start + nvalid
+
+        def put(path, big, small):
+            name = _leaf_name(path)
+            if name in ("cache_index", "pos_index"):
+                return big.at[slot].set(jnp.asarray(end, big.dtype))
+            if name == "slot_live":
+                return big.at[slot].set(arm.astype(big.dtype))
+            if name == "slot_pos":
+                return big.at[slot].set(
+                    jnp.where(small[0] < end, small[0], -1))
+            if name == "page_table":
+                return big  # engine-owned; the model never writes it
+            return small  # shared pools, mutated through the page table
+
+        cache2 = jax.tree_util.tree_map_with_path(put, cache, new)
+        last = jax.lax.dynamic_slice_in_dim(logits, nvalid - 1, 1, axis=1)[:, 0]
+        return cache2, last.astype(jnp.float32)
+
+    def _bind_impl(self, cache, slot, row):
+        """Write slot ``slot``'s WHOLE page-table row in every layer
+        (block ids are layer-agnostic: layer L's pool uses the same
+        numbering).  The row has a fixed length (``pages_per_slot``), so
+        one dispatch covers an admission's entire claimed prefix, a
+        chunk's block growth, or a decode tick's boundary crossing —
+        never one dispatch per page.  Page-table growth is DATA — the
+        compiled decode and chunk programs never change."""
+
+        def leaf(path, bg):
+            if _leaf_name(path) == "page_table":
+                return bg.at[slot].set(row.astype(bg.dtype))
+            return bg
+
+        return jax.tree_util.tree_map_with_path(leaf, cache)
+
+    def _release_impl(self, cache, slot):
+        """Park a freed paged slot: cursors to zero, page-table row and
+        ring positions to -1 ("unallocated / unwritten") — writes drop,
+        reads are mask-excluded, and the freed blocks are back on the
+        host free list."""
+
+        def leaf(path, bg):
+            name = _leaf_name(path)
+            if name in ("cache_index", "pos_index", "slot_live"):
+                return bg.at[slot].set(jnp.zeros((), bg.dtype))
+            if name in ("page_table", "slot_pos"):
+                return bg.at[slot].set(jnp.full((), -1, bg.dtype))
+            return bg
+
+        return jax.tree_util.tree_map_with_path(leaf, cache)
 
     def _sample(self, logits, temp, keys):
         """Greedy/temperature next-token draw, per row.
@@ -257,7 +477,35 @@ class LMEngine:
             return (jnp.zeros((1, self.model.vocab), jnp.float32),
                     jnp.zeros((1,), jnp.float32),
                     jnp.zeros((1, 2), jnp.uint32))
+        if program == "chunk":
+            return (self.params, self.cache,
+                    jnp.zeros((1, self.prefill_chunk), jnp.int32),
+                    jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+                    jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32))
+        if program == "bind":
+            return (self.cache, jnp.asarray(0, jnp.int32),
+                    jnp.full((self.layout.pages_per_slot,), -1, jnp.int32))
+        if program == "release":
+            return (self.cache, jnp.asarray(0, jnp.int32))
         raise ValueError(f"unknown engine program {program!r}")
+
+    def _aot_jobs(self):
+        """(name, jit, bucket) for every program this layout serves
+        through — the AOT pool and warmup iterate the same list."""
+        jobs = [("step", self._step_jit, None),
+                ("sample1", self._sample1_jit, None)]
+        if self.layout_name == "paged":
+            jobs += [("chunk", self._chunk_jit, None),
+                     ("bind", self._bind_jit, None),
+                     ("release", self._release_jit, None)]
+        else:
+            jobs += [("insert", self._insert_jit, None)]
+            shapes = set(self.buckets)
+            if self.prefill_chunk:
+                shapes.add(self.prefill_chunk)
+            jobs += [("prefill", self._prefill_jit, b)
+                     for b in sorted(shapes)]
+        return jobs
 
     def _load_aot(self, aot_dir: str) -> None:
         """Load-or-compile every engine program as a serialized AOT
@@ -271,26 +519,26 @@ class LMEngine:
         # everything that changes a compiled program without changing
         # argument shapes (windowing, norms, rope, ...) is in the model
         # repr (config_tag scrubs the addresses a callable field like
-        # attn_fn prints); max_len/buckets shape the cache and prefill
+        # attn_fn prints); max_len/buckets shape the cache and prefill,
+        # and the layout knobs shape the paged pool and chunk programs
         tag = compilation.config_tag(
-            repr(self.model), self.max_slots, self.max_len, self.buckets)
+            repr(self.decode_model), self.max_slots, self.max_len,
+            self.buckets, self.layout_name, self.prefill_chunk)
         fp = compilation.topology_fingerprint(tag=tag)
-        jobs = [("insert", self._insert_jit, None),
-                ("step", self._step_jit, None),
-                ("sample1", self._sample1_jit, None)]
-        jobs += [("prefill", self._prefill_jit, b) for b in self.buckets]
-        for name, fn, bucket in jobs:
+        for name, fn, bucket in self._aot_jobs():
             args = self._example_args(name, bucket)
             key = (name, bucket) if bucket is not None else name
             fname = f"serve_{name}" + (f"_b{bucket}" if bucket else "")
             self._aot[key] = compilation.load_or_compile(
                 fn, args, directory=aot_dir, name=fname, fingerprint=fp)
 
-    def _call_prefill(self, padded, plen):
+    def _call_prefill(self, padded, plen, cache0=None):
         fn = self._aot.get(("prefill", int(padded.shape[1])))
         if fn is None:
             fn = self._prefill_jit
-        return fn(self.params, self._prefill_zero, padded, plen)
+        if cache0 is None:
+            cache0 = self._prefill_zero
+        return fn(self.params, cache0, padded, plen)
 
     def _call_insert(self, small, slot, plen):
         fn = self._aot.get("insert", self._insert_jit)
@@ -304,13 +552,32 @@ class LMEngine:
         fn = self._aot.get("sample1", self._sample1_jit)
         return fn(logits, temp, keys)
 
+    def _call_chunk(self, toks, slot, start, nvalid, arm):
+        fn = self._aot.get("chunk", self._chunk_jit)
+        return fn(self.params, self.cache, toks,
+                  jnp.asarray(slot, jnp.int32),
+                  jnp.asarray(start, jnp.int32),
+                  jnp.asarray(nvalid, jnp.int32),
+                  jnp.asarray(arm, jnp.int32))
+
+    def _call_bind(self, slot):
+        """Push slot ``slot``'s host page-table row to the device —
+        ONE dispatch regardless of how many pages just changed."""
+        fn = self._aot.get("bind", self._bind_jit)
+        row = np.asarray(self.layout.slot_pages[slot], np.int32)
+        self.cache = fn(self.cache, jnp.asarray(slot, jnp.int32),
+                        jnp.asarray(row))
+
+    def _call_release(self, slot):
+        fn = self._aot.get("release", self._release_jit)
+        self.cache = fn(self.cache, jnp.asarray(slot, jnp.int32))
+
     def warmup(self) -> dict:
-        """Pre-pay every compile before the first request: one prefill
-        per bucket, one splice, one all-slot decode step, one sample —
-        then rebuild pristine slot state, so the warmed engine is
-        indistinguishable from a fresh one except that no program
-        compiles on the serving path again (the ONE-decode-compile
-        invariant holds with the compile moved ahead of traffic).
+        """Pre-pay every compile before the first request — then rebuild
+        pristine slot state, so the warmed engine is indistinguishable
+        from a fresh one except that no program compiles on the serving
+        path again (the ONE-decode-compile invariant holds with the
+        compile moved ahead of traffic).
 
         Returns ``{"seconds": ..., "compiles": ...}`` (compiles == 0
         when an AOT pool or a warm persistent cache made even warmup
@@ -323,16 +590,32 @@ class LMEngine:
         jaxmon.install()
         c0 = jaxmon.compile_count()
         t0 = time.perf_counter()
-        small = last = None
-        for b in self.buckets:
-            small, last = self._call_prefill(
-                jnp.zeros((1, b), jnp.int32), jnp.asarray(1, jnp.int32))
-        self._call_sample1(
-            last, jnp.zeros((1,), jnp.float32), jnp.zeros((1, 2), jnp.uint32))
-        # the splice and step donate the live slot state; the dummy data
-        # they leave behind is discarded with the rebuild below
-        self.cache = self._call_insert(
-            small, jnp.asarray(0, jnp.int32), jnp.asarray(1, jnp.int32))
+        if self.layout_name == "paged":
+            # chunk against the pristine all-unallocated page tables:
+            # every write drops, every read is masked — pure compile
+            self.cache, last = self._call_chunk(
+                jnp.zeros((1, self.prefill_chunk), jnp.int32), 0, 0, 1, 0)
+            self._call_sample1(
+                last, jnp.zeros((1,), jnp.float32),
+                jnp.zeros((1, 2), jnp.uint32))
+            self._call_bind(0)
+            self._call_release(0)
+        else:
+            small = last = None
+            for b in self.buckets:
+                small, last = self._call_prefill(
+                    jnp.zeros((1, b), jnp.int32), jnp.asarray(1, jnp.int32))
+            if self.prefill_chunk and self.prefill_chunk not in self.buckets:
+                small, last = self._call_prefill(
+                    jnp.zeros((1, self.prefill_chunk), jnp.int32),
+                    jnp.asarray(1, jnp.int32))
+            self._call_sample1(
+                last, jnp.zeros((1,), jnp.float32),
+                jnp.zeros((1, 2), jnp.uint32))
+            # the splice and step donate the live slot state; the dummy
+            # data they leave behind is discarded with the rebuild below
+            self.cache = self._call_insert(
+                small, jnp.asarray(0, jnp.int32), jnp.asarray(1, jnp.int32))
         self.cache, self._tok, self._keys = self._call_step()
         jax.block_until_ready(self._tok)
         self.cache = make_decode_cache(
@@ -363,18 +646,141 @@ class LMEngine:
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
-        self.pick_bucket(prompt_len)
+        if self.buckets:
+            self.pick_bucket(prompt_len)
         if prompt_len + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
                 f"= {prompt_len + max_new_tokens} exceeds the engine's slot "
                 f"cache (max_len={self.max_len}). Lower max_new_tokens or "
                 "rebuild the engine with a larger max_len.")
+        if self.layout_name == "paged":
+            need = self.layout.pages_for(prompt_len + max_new_tokens)
+            total = self.layout.pool.num_blocks
+            if need > total:
+                raise ValueError(
+                    f"request needs {need} KV blocks at its token budget "
+                    f"(prompt {prompt_len} + max_new_tokens "
+                    f"{max_new_tokens}, block size "
+                    f"{self.layout.block_size}) but the pool only has "
+                    f"{total}. Lower max_new_tokens, or rebuild the engine "
+                    f"with kv_blocks >= {need}.")
 
-    def prefill(self, slot: int, tokens: Sequence[int], temperature: float,
-                key: np.ndarray):
-        """Prefill ``tokens`` into slot ``slot`` and arm its on-device
-        sampling state; returns ``(first_token, bucket)``."""
+    def can_admit(self, prompt: Sequence[int], max_new_tokens: int) -> bool:
+        """Admission gate beyond free slots: in the paged layout a
+        request is only admitted when the block pool can cover its
+        WORST-CASE footprint on top of every already-admitted slot's —
+        so an admitted request can always run to its budget and pool
+        exhaustion surfaces as queueing, never as a stuck slot."""
+        return self.layout.can_admit(prompt, max_new_tokens)
+
+    # ---- prefill (whole-prompt and incremental) ---------------------------
+
+    def prefill_begin(self, slot: int, tokens: Sequence[int],
+                      temperature: float, key: np.ndarray,
+                      max_new_tokens: Optional[int] = None) -> _PrefillState:
+        """Start prefilling ``tokens`` into ``slot``; the scheduler
+        advances the returned state one chunk per :meth:`prefill_step`
+        call (interleaving chunks with decode ticks).  ``max_new_tokens``
+        sizes the paged worst-case reservation (default: the whole slot
+        budget) — pass the request's real bound so the reservation
+        matches what :meth:`can_admit` agreed to."""
+        st = _PrefillState(slot, tokens, temperature, key)
+        if self.layout_name == "paged":
+            budget = (self.max_len - st.plen if max_new_tokens is None
+                      else max_new_tokens)
+            start = self.layout.admit(slot, st.tokens, budget)
+            st.pos = start
+            if start:
+                # claimed prefix pages go live on device now — one
+                # row-bind dispatch however long the cached prefix is
+                self._call_bind(slot)
+            self._host_pos[slot] = start
+        else:
+            st.small = self._prefill_zero
+        return st
+
+    def prefill_step(self, st: _PrefillState):
+        """Advance one chunk (or, without chunking, the whole prompt).
+        Returns ``(first_token | None, real_tokens, padded_tokens)`` —
+        a non-None first token means prefill completed and the slot is
+        armed for decode."""
+        if not self.prefill_incremental:
+            first, bucket = self._prefill_whole(
+                st.slot, st.tokens, st.temperature, st.key)
+            return first, st.plen, bucket
+        chunk = self.prefill_chunk
+        nvalid = min(chunk, st.plen - st.pos)
+        final = st.pos + nvalid >= st.plen
+        padded = np.zeros((1, chunk), np.int32)
+        padded[0, :nvalid] = st.tokens[st.pos:st.pos + nvalid]
+        if self.layout_name == "paged":
+            if self.layout.alloc_rows(st.slot, st.pos + nvalid):
+                self._call_bind(st.slot)
+            # arm flips the slot_live write gate on the final chunk —
+            # until then decode-tick drift writes drop for this row
+            self.cache, last = self._call_chunk(
+                jnp.asarray(padded), st.slot, st.pos, nvalid,
+                1 if final else 0)
+        else:
+            start = st.pos
+            if start + chunk > self.max_len:
+                # a padded FINAL chunk would write past the batch-1
+                # cache and dynamic_update_slice clamps the start back,
+                # corrupting earlier rows — shift the window back
+                # instead: re-prefilled positions rewrite identical K/V
+                # (same token, same position), pad rows land in
+                # [plen, max_len) where decode's own write precedes any
+                # attending query (the whole-bucket padding argument)
+                start = self.max_len - chunk
+                padded[0] = 0
+                padded[0, :st.plen - start] = st.tokens[start:st.plen]
+                nvalid_w = st.pos + nvalid - start
+
+                def rewind(path, leaf):
+                    if _leaf_name(path) in ("cache_index", "pos_index"):
+                        return jnp.full_like(leaf, start)
+                    return leaf
+
+                st.small = jax.tree_util.tree_map_with_path(
+                    rewind, st.small)
+            else:
+                nvalid_w = nvalid
+            st.small, last = self._call_prefill(
+                jnp.asarray(padded), jnp.asarray(nvalid_w, jnp.int32),
+                cache0=st.small)
+        st.pos += nvalid
+        st.padded += chunk
+        if st.pos < st.plen:
+            return None, nvalid, chunk
+        # final chunk: splice (dense), arm sampling state, first token
+        if self.layout_name == "dense":
+            self.cache = self._call_insert(
+                st.small, jnp.asarray(st.slot, jnp.int32),
+                jnp.asarray(st.plen, jnp.int32))
+        else:
+            self.layout.register_prompt(st.slot, st.tokens)
+            self._host_pos[st.slot] = st.plen
+            self._decoding.add(st.slot)
+        first = self._arm(st.slot, last, st.temperature, st.key)
+        return first, nvalid, chunk
+
+    def _arm(self, slot: int, last_logits, temperature: float, key) -> int:
+        """Sample the first token from the prefill logits and arm the
+        slot's on-device sampling state."""
+        nxt, new_key = self._call_sample1(
+            last_logits, jnp.asarray([temperature], jnp.float32),
+            jnp.asarray(key)[None])
+        first = int(np.asarray(nxt)[0])
+        self._tok = self._tok.at[slot].set(first)
+        self._temp = self._temp.at[slot].set(float(temperature))
+        self._keys = self._keys.at[slot].set(new_key[0])
+        return first
+
+    def _prefill_whole(self, slot: int, tokens: Sequence[int],
+                       temperature: float, key: np.ndarray):
+        """The original dense whole-prompt path: one bucketed prefill
+        spliced into the slot; returns ``(first_token, bucket)``."""
         plen = len(tokens)
         bucket = self.pick_bucket(plen)
         padded = np.zeros((1, bucket), np.int32)
@@ -383,20 +789,38 @@ class LMEngine:
             jnp.asarray(padded), jnp.asarray(plen, jnp.int32))
         self.cache = self._call_insert(
             small, jnp.asarray(slot, jnp.int32), jnp.asarray(plen, jnp.int32))
-        nxt, new_key = self._call_sample1(
-            last, jnp.asarray([temperature], jnp.float32),
-            jnp.asarray(key)[None])
-        first = int(np.asarray(nxt)[0])
-        self._tok = self._tok.at[slot].set(first)
-        self._temp = self._temp.at[slot].set(float(temperature))
-        self._keys = self._keys.at[slot].set(new_key[0])
+        first = self._arm(slot, last, temperature, key)
         return first, bucket
+
+    def prefill(self, slot: int, tokens: Sequence[int], temperature: float,
+                key: np.ndarray):
+        """Prefill ``tokens`` into slot ``slot`` and arm its on-device
+        sampling state; returns ``(first_token, padded_tokens)``.  Runs
+        every chunk back-to-back — the scheduler uses the incremental
+        API instead when it wants chunks interleaved with decode."""
+        st = self.prefill_begin(slot, tokens, temperature, key)
+        if not self.prefill_incremental:
+            return self.prefill_step(st)[0], self.pick_bucket(st.plen)
+        while True:
+            first, _, _ = self.prefill_step(st)
+            if first is not None:
+                return first, st.padded
+
+    # ---- decode / teardown ------------------------------------------------
 
     def step_decode(self) -> np.ndarray:
         """One compiled step over all slots; per-slot input tokens, keys
         and temperatures live on device — the only host traffic is the
         returned ``next[S]`` (the scheduler's stop checks/streaming).
-        Parked rows compute too; their output is discarded."""
+        Parked rows compute too; their output is discarded.  In the
+        paged layout, each decoding slot's next write position is
+        covered by a just-in-time block bind BEFORE the compiled step
+        (reservation guarantees the pool can serve it)."""
+        if self.layout_name == "paged":
+            for slot in self._decoding:
+                if self.layout.alloc_rows(slot, self._host_pos[slot] + 1):
+                    self._call_bind(slot)
+                self._host_pos[slot] += 1
         self.cache, self._tok, self._keys = self._call_step()
         return np.asarray(self._tok)
 
@@ -404,16 +828,47 @@ class LMEngine:
         """Park a freed slot: zero its cursor (so it cannot creep toward
         int32 wraparound across very long serving sessions) and its
         temperature.  Parked slots still ride the compiled step; their
-        writes/outputs are masked/discarded."""
+        writes/outputs are masked/discarded.  The paged layout also
+        returns the slot's blocks to the pool (prefix-cached blocks stay
+        reclaimable) and clears its device page-table row."""
+        if self.layout_name == "paged":
+            self.layout.release(slot)
+            self._call_release(slot)
+            self._decoding.discard(slot)
+            self._host_pos[slot] = 0
+        else:
+            def leaf(path, bg):
+                name = _leaf_name(path)
+                if name in ("cache_index", "pos_index"):
+                    return bg.at[slot].set(jnp.zeros((), bg.dtype))
+                return bg
 
-        def leaf(path, bg):
-            name = getattr(path[-1], "key", None)
-            if name in ("cache_index", "pos_index"):
-                return bg.at[slot].set(jnp.zeros((), bg.dtype))
-            return bg
-
-        self.cache = jax.tree_util.tree_map_with_path(leaf, self.cache)
+            self.cache = jax.tree_util.tree_map_with_path(leaf, self.cache)
         self._temp = self._temp.at[slot].set(0.0)
+
+    # ---- reporting --------------------------------------------------------
+
+    def pool_stats(self) -> dict:
+        """Block-pool occupancy and prefix-cache counters (empty for the
+        dense layout — it has no pool)."""
+        if self.layout_name != "paged":
+            return {}
+        return self.layout.stats()
+
+    def kv_cache_bytes(self) -> dict:
+        """KV HBM accounting: ``reserved`` is what the cache tensors
+        occupy; ``live`` is the fraction actually backing live tokens
+        (== reserved for dense — the whole point of the paged layout is
+        the gap between the two)."""
+        total = 0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(self.cache)[0]:
+            if _leaf_name(path) in ("cached_k", "cached_v"):
+                total += leaf.size * leaf.dtype.itemsize
+        if self.layout_name != "paged":
+            return {"reserved": total, "live": total}
+        s = self.layout.stats()
+        frac = s["kv_blocks_active"] / max(1, s["kv_blocks_total"])
+        return {"reserved": total, "live": int(total * frac)}
 
     def compile_stats(self) -> dict:
         """Compile counts per program — the no-recompile steady-state
@@ -421,10 +876,21 @@ class LMEngine:
         ``prewarm=True`` engine satisfies it before the first request).
         An AOT engine serves through deserialized executables instead of
         the jits, so its jit cache sizes stay 0 and ``aot_programs``
-        reports the loaded pool instead."""
-        return {
+        reports the loaded pool instead.  The paged layout's prefill
+        program is the chunk program; its page-table maintenance
+        programs (``bind``/``release``) are reported so tests can pin
+        the WHOLE pool at one compile each."""
+        stats = {
             "decode_compiles": _jit_cache_size(self._step_jit),
-            "prefill_compiles": _jit_cache_size(self._prefill_jit),
-            "insert_compiles": _jit_cache_size(self._insert_jit),
+            "insert_compiles": (
+                _jit_cache_size(self._insert_jit)
+                if self.layout_name == "dense" else 0),
             "aot_programs": len(self._aot),
         }
+        if self.layout_name == "paged":
+            stats["prefill_compiles"] = _jit_cache_size(self._chunk_jit)
+            stats["bind_compiles"] = _jit_cache_size(self._bind_jit)
+            stats["release_compiles"] = _jit_cache_size(self._release_jit)
+        else:
+            stats["prefill_compiles"] = _jit_cache_size(self._prefill_jit)
+        return stats

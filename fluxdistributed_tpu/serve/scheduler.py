@@ -1,25 +1,36 @@
 """Prefill/decode scheduler: FIFO admission, per-request stopping,
 backpressure, and serving metrics.
 
-One loop drives the engine's two compiled programs:
+One loop drives the engine's compiled programs:
 
 * **decode phase** — if any slot is live, ONE fixed-shape step over all
   slots; per-slot next tokens are emitted, stop conditions checked
   (``max_new_tokens`` / EOS), and finished requests free their slot.
-* **admit phase** — free slots are filled from the bounded FIFO queue:
-  each admission runs one bucketed prefill and splices the result into
-  its slot, so waiting requests join MID-FLIGHT without recompiling or
-  disturbing live slots.  The first generated token comes from the
-  prefill logits (that draw is the time-to-first-token).
+* **admit phase** — free slots are filled from the bounded FIFO queue.
+  Admission is gated on the engine's ``can_admit`` (paged layout: the
+  block pool must cover the request's worst case on top of every
+  already-admitted slot's — pool exhaustion queues at the head instead
+  of admitting a request that could then never run to its budget).
+  Without chunked prefill an admission runs one bucketed prefill and
+  splices the result into its slot; with it the admission only BEGINS
+  the prefill.
+* **chunk phase** — at most ``prefill_chunks_per_tick`` prefill chunks
+  advance per tick, round-robin over prefilling slots.  A long prompt's
+  ingestion is spread across ticks between decode steps, so it can no
+  longer spike TTFT for every resident request; the first generated
+  token still comes from the (final chunk's) prefill logits.
 
 Decode-before-admit means a slot freed by an EOS in step N is re-filled
 within the same ``step()`` call — continuous batching, not gang
 scheduling.  Backpressure is the bounded queue: ``submit`` raises
-:class:`QueueFull` (the HTTP front end maps it to 429).
+:class:`QueueFull` (the HTTP front end maps it to 429).  ``cancel``
+aborts a request (client disconnect): queued requests leave the queue
+immediately, active ones are torn down — slot freed, paged blocks
+returned to the pool — on the driver thread's next tick.
 
-Thread model: ``submit``/``metrics`` may be called from any thread;
-``step``/``run_until_idle`` must run on ONE driver thread (the server's
-engine loop, or the test body).
+Thread model: ``submit``/``metrics``/``cancel`` may be called from any
+thread; ``step``/``run_until_idle`` must run on ONE driver thread (the
+server's engine loop, or the test body).
 """
 
 from __future__ import annotations
@@ -67,7 +78,8 @@ class Request:
 
     # scheduler-owned state
     generated: List[int] = field(default_factory=list)
-    state: str = "queued"  # queued | active | done
+    state: str = "queued"  # queued | prefilling | active | done
+    cancelled: bool = False  # set by cancel(); serviced on driver thread
     slot: Optional[int] = None
     done: threading.Event = field(default_factory=threading.Event)
     submitted_at: Optional[float] = None
@@ -91,11 +103,20 @@ class Scheduler:
     co-expose serving metrics with trainer/jax metrics on one scrape."""
 
     def __init__(self, engine: LMEngine, max_queue: int = 64,
-                 registry: Optional[Registry] = None):
+                 registry: Optional[Registry] = None,
+                 prefill_chunks_per_tick: int = 1):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if prefill_chunks_per_tick < 1:
+            raise ValueError(f"prefill_chunks_per_tick must be >= 1, got "
+                             f"{prefill_chunks_per_tick}")
         self.engine = engine
         self.max_queue = max_queue
+        #: chunk budget per tick when the engine prefills incrementally —
+        #: 1 keeps decode cadence tight (one chunk rides between steps);
+        #: raise it to favor prompt ingestion over decode latency
+        self.prefill_chunks_per_tick = prefill_chunks_per_tick
+        self._rr = -1  # round-robin cursor over prefilling slots
         self._queue: deque[Request] = deque()
         self._lock = threading.Lock()
         self._work = threading.Event()
@@ -116,6 +137,24 @@ class Scheduler:
         self._c_ttft_count = c(p + "ttft_count", "requests that produced a first token")
         self._h_ttft = r.histogram(
             p + "ttft_seconds", "time-to-first-token distribution")
+        # chunked-prefill + paged-pool series (all zero / static for a
+        # dense whole-prefill engine — the names are registered either
+        # way so scrapes and close() are layout-independent)
+        self._c_prefill_chunks = c(
+            p + "prefill_chunks", "prefill chunks executed")
+        self._g_chunks_last = g(
+            p + "prefill_chunks_last_tick",
+            "prefill chunks run in the most recent tick")
+        self._c_cancelled = c(
+            p + "requests_cancelled",
+            "requests aborted (client disconnect / cancel)")
+        self._c_prefix_hits = c(
+            p + "prefix_cache_hits", "prefix-cache block hits")
+        self._c_prefix_misses = c(
+            p + "prefix_cache_misses", "prefix-cache block misses")
+        self._c_prefix_evictions = c(
+            p + "prefix_cache_evictions",
+            "prefix-cached blocks evicted under pool pressure")
         # point-in-time values render at scrape time (zero hot-path cost);
         # the compile gauges make the engine's ONE-decode-compile
         # invariant a LIVE metric, not just an offline test assertion
@@ -135,14 +174,46 @@ class Scheduler:
             g(p + key, "compiled-program count (steady state: decode "
                        "stays at 1)").set_function(
                 lambda key=key: self.engine.compile_stats()[key])
+        # block-pool occupancy (paged layout; reads 0 on dense engines):
+        # free + cached is what admission reservations can draw on
+        for key, txt in (
+            ("kv_blocks_total", "KV block pool size per layer"),
+            ("kv_blocks_free", "KV blocks on the free list"),
+            ("kv_blocks_active", "KV blocks referenced by live slots"),
+            ("kv_blocks_cached", "prefix-cached KV blocks (reclaimable)"),
+        ):
+            g(p + key, txt).set_function(
+                lambda key=key: float(self._pool_stat(key)))
         self._callback_gauges = [
             p + k for k in (
                 "queue_depth", "active_slots", "max_slots",
                 "prefill_tokens_per_sec", "decode_tokens_per_sec",
                 "ttft_sec_avg", "decode_compiles", "prefill_compiles",
-                "insert_compiles",
+                "insert_compiles", "kv_blocks_total", "kv_blocks_free",
+                "kv_blocks_active", "kv_blocks_cached",
             )
         ]
+
+    def _pool_stat(self, key: str) -> float:
+        ps = getattr(self.engine, "pool_stats", None)
+        return (ps() if callable(ps) else {}).get(key, 0)
+
+    def _sync_prefix_counters(self) -> None:
+        """Fold the engine's cumulative prefix-cache tallies into the
+        registry counters (delta-sync keeps Prometheus counter
+        semantics — a shared registry's totals stay monotone across
+        scheduler restarts)."""
+        ps = getattr(self.engine, "pool_stats", None)
+        if not callable(ps):
+            return
+        s = ps()
+        for ctr, key in ((self._c_prefix_hits, "prefix_cache_hits"),
+                         (self._c_prefix_misses, "prefix_cache_misses"),
+                         (self._c_prefix_evictions,
+                          "prefix_cache_evictions")):
+            d = s.get(key, 0) - ctr.value()
+            if d > 0:
+                ctr.inc(d)
 
     @staticmethod
     def _rate(num, den) -> float:
@@ -184,6 +255,43 @@ class Scheduler:
         self._work.wait(timeout)
         self._work.clear()
 
+    def cancel(self, req: Request) -> bool:
+        """Abort a request (client disconnect).  A queued request leaves
+        the queue immediately (returns True); a prefilling/active one is
+        flagged and torn down — slot freed, paged KV blocks back to the
+        pool — at the start of the driver thread's next tick (returns
+        False; ``req.done`` is set once the teardown ran)."""
+        with self._lock:
+            if req.state == "queued":
+                try:
+                    self._queue.remove(req)
+                except ValueError:
+                    pass  # raced with admission; fall through to the flag
+                else:
+                    req.state = "done"
+                    req.finished_at = time.monotonic()
+                    self._c_cancelled.inc()
+                    req.done.set()
+                    return True
+            if req.state == "done":
+                return True
+            req.cancelled = True
+        self._work.set()
+        return False
+
+    def _service_cancels(self) -> None:
+        """Driver-thread half of :meth:`cancel`: free the slot and the
+        engine-side resources of every flagged request."""
+        for s, r in enumerate(self.slots):
+            if r is not None and r.cancelled:
+                self.slots[s] = None
+                self.engine.reset_slot(s)
+                r.slot = None
+                r.state = "done"
+                r.finished_at = time.monotonic()
+                self._c_cancelled.inc()
+                r.done.set()
+
     # ---- driver side (one thread) -----------------------------------------
 
     @property
@@ -200,11 +308,15 @@ class Scheduler:
         return self.active_slots == 0 and self.queue_depth == 0
 
     def step(self) -> int:
-        """One scheduler tick: decode live slots, then admit from the
-        queue into whatever is free (including slots freed THIS tick).
+        """One scheduler tick: tear down cancelled requests, decode live
+        slots, admit from the queue into whatever is free (including
+        slots freed THIS tick), then advance at most
+        ``prefill_chunks_per_tick`` prefill chunks (chunked engines).
         Returns the number of tokens emitted."""
         emitted = 0
-        live = [s for s, r in enumerate(self.slots) if r is not None]
+        self._service_cancels()
+        live = [s for s, r in enumerate(self.slots)
+                if r is not None and r.state == "active"]
         if live:
             t0 = time.monotonic()
             nxt = self.engine.step_decode()
@@ -213,7 +325,14 @@ class Scheduler:
             for s in live:
                 self._emit(self.slots[s], int(nxt[s]))
                 emitted += 1
-        # admit into free slots (possibly just freed by EOS above)
+        # admit into free slots (possibly just freed by EOS above).
+        # Admission is FIFO: when the HEAD cannot be admitted (paged
+        # block-pool reservation would overcommit), it WAITS — no
+        # head-of-line skipping, so a big request cannot be starved by
+        # a stream of small ones.
+        incremental = bool(getattr(self.engine, "prefill_incremental",
+                                   False))
+        can_admit = getattr(self.engine, "can_admit", None)
         while True:
             try:
                 free = self.slots.index(None)
@@ -222,7 +341,19 @@ class Scheduler:
             with self._lock:
                 if not self._queue:
                     break
-                req = self._queue.popleft()
+                req = self._queue[0]
+                if (can_admit is not None
+                        and not can_admit(req.prompt, req.max_new_tokens)):
+                    break
+                self._queue.popleft()
+            if incremental:
+                req._pf = self.engine.prefill_begin(
+                    free, req.prompt, req.temperature, req._key,
+                    max_new_tokens=req.max_new_tokens)
+                req.state = "prefilling"
+                req.slot = free
+                self.slots[free] = req
+                continue
             t0 = time.monotonic()
             first, bucket = self.engine.prefill(
                 free, req.prompt, req.temperature, req._key)
@@ -234,6 +365,31 @@ class Scheduler:
             self.slots[free] = req
             self._emit(req, first)
             emitted += 1
+        # chunk phase: round-robin the budget over prefilling slots so a
+        # long prompt shares the tick with everyone else's chunks
+        chunks_run = 0
+        if incremental:
+            for _ in range(self.prefill_chunks_per_tick):
+                pf = [s for s, r in enumerate(self.slots)
+                      if r is not None and r.state == "prefilling"]
+                if not pf:
+                    break
+                s = next((x for x in pf if x > self._rr), pf[0])
+                self._rr = s
+                req = self.slots[s]
+                t0 = time.monotonic()
+                first, nreal, npad = self.engine.prefill_step(req._pf)
+                self._c_prefill_sec.inc(time.monotonic() - t0)
+                self._c_prefill_tokens.inc(nreal)
+                self._c_prefill_padded.inc(npad)
+                self._c_prefill_chunks.inc()
+                chunks_run += 1
+                if first is not None:
+                    req.state = "active"
+                    self._emit(req, first)
+                    emitted += 1
+            self._g_chunks_last.set(chunks_run)
+        self._sync_prefix_counters()
         return emitted
 
     def run_until_idle(self, max_steps: int = 1_000_000) -> None:
@@ -314,5 +470,11 @@ class Scheduler:
             # active requests have already produced TTFT samples
             "ttft_sec_avg": self._rate(self._c_ttft_sum, self._c_ttft_count),
         }
+        self._sync_prefix_counters()
+        m["prefill_chunks"] = self._c_prefill_chunks.value()
+        m["requests_cancelled"] = self._c_cancelled.value()
+        ps = getattr(self.engine, "pool_stats", None)
+        if callable(ps):
+            m.update(ps())
         m.update(self.engine.compile_stats())
         return m

@@ -296,7 +296,11 @@ class LMServer:
                                "error": "generation timed out",
                                **self._result(req)})
                 except (BrokenPipeError, ConnectionResetError):
-                    pass  # client went away; the request still drains
+                    # client went away mid-stream: cancel so the slot —
+                    # and, on a paged engine, its KV blocks — frees on
+                    # the next tick instead of decoding to max_tokens
+                    # for nobody
+                    outer.scheduler.cancel(req)
                 finally:
                     try:
                         self.wfile.write(b"0\r\n\r\n")
