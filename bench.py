@@ -228,6 +228,273 @@ def default_cache_dir():
                         "benchmarks", "hw", "xla_cache")
 
 
+def default_aot_dir():
+    """Serialized-executable directory for the resumable bench
+    (``FDTPU_AOT_DIR`` overrides): where attempt N leaves the compiled
+    train-step so attempt N+1 skips tracing+lowering+compilation
+    entirely."""
+    import os
+
+    env = os.environ.get("FDTPU_AOT_DIR")
+    if env is not None:
+        return env or None
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "hw", "aot")
+
+
+def _unavailable_sigs():
+    """The canonical backend-unavailable signature list lives in
+    ``fluxdistributed_tpu.faults`` (one source, no drift); a frozen
+    fallback keeps the error-JSON path alive even when the package
+    itself cannot import (that is precisely an error path)."""
+    try:
+        from fluxdistributed_tpu.faults import UNAVAILABLE_SIGNATURES
+
+        return UNAVAILABLE_SIGNATURES
+    except Exception:  # noqa: BLE001 — classification must never crash
+        return ("UNAVAILABLE", "DEADLINE_EXCEEDED", "failed to connect",
+                "Connection reset", "Connection refused", "Socket closed",
+                "response body closed", "remote_compile",
+                "No visible device", "Unable to initialize backend",
+                "timed out", "per-attempt bound")
+
+
+def retryable_error(phase: str, err: str) -> bool:
+    """Phase-aware transient/permanent classification for bench error
+    JSON: the availability watcher backs off and retries ONLY when this
+    says True — a real code failure must stop the hammering and page a
+    human instead of burning grant windows on it.
+
+    * ``backend_init`` — always retryable: death while acquiring the
+      backend IS the unavailability being waited out;
+    * everything else (``build`` / ``compile`` / ``measure``) —
+      retryable only when the error carries a backend-unavailable
+      signature (tunnel drop, runtime eviction, timeout: the
+      compile-window expiry the resumable protocol resumes from shows
+      up as a timeout signature).  A deterministic Python/XLA error in
+      any phase — including compile — is permanent: retrying a broken
+      build burns grant windows without ever succeeding.
+    """
+    if phase == "backend_init":
+        return True
+    err = err or ""
+    return any(sig in err for sig in _unavailable_sigs())
+
+
+def _read_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+
+
+def _write_json_atomic(path, obj):
+    import os
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def _measure_compiled(compiled, state, b, steps: int):
+    """Steady-state seconds/step of an AOT executable: one landing call
+    (allocator warm-up), then ``steps`` timed calls.  The executable
+    donates its state input (build_step default), so the returned state
+    is carried exactly like the jit measurement path."""
+    import time as _time
+
+    import jax
+
+    state, m = compiled(state, b)
+    jax.block_until_ready(m["loss"])
+    t0 = _time.perf_counter()
+    for _ in range(steps):
+        state, m = compiled(state, b)
+    jax.block_until_ready(m["loss"])
+    return (_time.perf_counter() - t0) / steps
+
+
+def resumable_main(argv=None) -> int:
+    """``bench.py --resumable``: the time-boxed, attempt-chained bench.
+
+    Every previous hardware round died because ONE attempt had to
+    survive backend acquisition AND full compilation AND measurement
+    inside one grant window.  This mode is a state machine persisted in
+    an attempt ledger (JSON, atomic writes): attempt N acquires the
+    backend with retries, warms the persistent compile cache, and
+    serializes the compiled step as an AOT executable — that progress
+    is durable.  Attempt N+1 (any later process) loads the executable
+    (no tracing, no lowering, no compiling) and measures a HANDFUL of
+    steps — emitting a partial-but-real number with ``attempts`` /
+    ``interrupted_at`` provenance instead of a perfect number never.
+    When one attempt has budget for both halves it finishes in one go.
+
+    Always prints exactly one JSON line and exits 0; errors carry a
+    phase-aware ``retryable`` flag so the watcher backs off only on
+    availability problems (``benchmarks/hw_watch.sh``).
+    """
+    import argparse
+    import os
+
+    ap = argparse.ArgumentParser(prog="bench.py --resumable")
+    ap.add_argument("--ledger", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks", "hw", "resumable.json"))
+    ap.add_argument("--budget", type=float,
+                    default=float(os.environ.get("BENCH_BUDGET", 360.0)),
+                    help="wall-second box for THIS attempt; progress "
+                         "past the warm phase persists either way")
+    ap.add_argument("--steps", type=int, default=8,
+                    help="measured steps (a handful: partial-but-real)")
+    ap.add_argument("--measure-margin", type=float, default=45.0,
+                    help="minimum budget left to attempt the measure "
+                         "phase in the same attempt that warmed")
+    args = ap.parse_args(argv)
+
+    deadline = time.monotonic() + args.budget
+    ledger = _read_json(args.ledger) or {
+        "version": 1, "state": "cold", "attempts": []}
+    attempt = {"n": len(ledger["attempts"]) + 1, "phase": "backend_init",
+               "budget": args.budget}
+    ledger["attempts"].append(attempt)
+    status_path = os.environ.get("BENCH_STATUS_FILE")
+
+    def phase(p):
+        attempt["phase"] = p
+        _write_status(status_path, p)
+        _write_json_atomic(args.ledger, ledger)
+
+    def provenance():
+        failed = [a.get("phase") for a in ledger["attempts"]
+                  if "error" in a]
+        return {
+            "attempts": len(ledger["attempts"]),
+            "interrupted_at": failed[-1] if failed else None,
+            "state": ledger["state"],
+            "ledger": args.ledger,
+        }
+
+    try:
+        from fluxdistributed_tpu import compilation, faults
+        from fluxdistributed_tpu.obs import jaxmon
+
+        jaxmon.install()
+        phase("backend_init")
+        # bounded, retried, classified: a non-granting chip costs
+        # minutes here, never a wedged process
+        faults.acquire_backend(
+            tries=3, timeout=min(120.0, max(30.0, args.budget / 3)),
+            backoff=10.0, budget=max(30.0, deadline - time.monotonic()))
+
+        import jax
+
+        platform = jax.devices()[0].platform
+        nchips = jax.device_count()
+        per_chip_batch = 256 if platform == "tpu" else 8
+        batch = per_chip_batch * nchips
+
+        cache_dir = compilation.enable_persistent_cache(default_cache_dir())
+        phase("build")
+        step, state, b = build_step(batch)
+        fl = step_flops(step, state, b)
+
+        phase("compile")
+        aot_dir = default_aot_dir()
+        fp = compilation.topology_fingerprint(
+            tag=compilation.config_tag("bench_resumable", batch))
+        sig = compilation.abstract_signature((state, b))
+        aot_path = None
+        compiled = None
+        if aot_dir:
+            aot_path = os.path.join(
+                aot_dir, f"bench_step-{fp}-{sig}{compilation.AOT_SUFFIX}")
+            compiled = compilation.load_executable(aot_path, fingerprint=fp)
+        loaded = compiled is not None
+        if compiled is None:
+            compiled = compilation.aot_compile(step, state, b)
+            if aot_path:
+                compilation.save_executable(
+                    aot_path, compiled, fingerprint=fp)
+        cm = compilation.compile_metrics()
+        warmed_before = ledger["state"] in ("warmed", "measured")
+        if ledger["state"] == "cold":
+            ledger["state"] = "warmed"
+        attempt["aot_loaded"] = loaded
+        attempt["compile_seconds"] = cm["compile_seconds"]
+
+        if (not (loaded or warmed_before)
+                and deadline - time.monotonic() < args.measure_margin):
+            # this attempt paid the cold half; bank it and yield the
+            # window — the NEXT attempt starts at the measure phase
+            phase("warmed")
+            print(json.dumps({
+                "metric": "ResNet-50 train-step throughput "
+                          f"({platform}, global batch {batch}, bf16)",
+                "value": 0.0,
+                "unit": "images/sec/chip",
+                "vs_baseline": 0.0,
+                "warmed": True,
+                "resumable": provenance(),
+                "compile_seconds": cm["compile_seconds"],
+                "cache_hits": cm["cache_hits"],
+                "cache_misses": cm["cache_misses"],
+                "compile_cache_dir": cache_dir,
+                "aot_path": aot_path,
+                "lint": lint_stamp(),
+            }))
+            return 0
+
+        phase("measure")
+        dt = _measure_compiled(compiled, state, b, args.steps)
+        ledger["state"] = "measured"
+        phase("done")
+        ips_per_chip = batch / dt / nchips
+        vs = (ips_per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP
+              if BASELINE_IMAGES_PER_SEC_PER_CHIP else 1.0)
+        print(json.dumps({
+            "metric": "ResNet-50 train-step throughput "
+                      f"({platform}, global batch {batch}, bf16)",
+            "value": round(ips_per_chip, 2),
+            "unit": "images/sec/chip",
+            "vs_baseline": round(vs, 3),
+            "mfu_pct": mfu_pct(fl, dt, nchips),
+            "measure_steps": args.steps,
+            "aot_loaded": loaded,
+            "resumable": provenance(),
+            "compile_seconds": cm["compile_seconds"],
+            "cache_hits": cm["cache_hits"],
+            "cache_misses": cm["cache_misses"],
+            "compile_seconds_saved": cm["compile_seconds_saved"],
+            "compile_cache_dir": cache_dir,
+            "lint": lint_stamp(),
+        }))
+        return 0
+    except BaseException as e:  # noqa: BLE001 — always emit the JSON line
+        traceback.print_exc(file=sys.stderr)
+        err = f"{type(e).__name__}: {e}"
+        attempt["error"] = err[:500]
+        try:
+            _write_json_atomic(args.ledger, ledger)
+        except OSError:
+            pass
+        print(json.dumps({
+            "metric": "ResNet-50 train-step throughput",
+            "value": 0.0,
+            "unit": "images/sec/chip",
+            "vs_baseline": 0.0,
+            "error": err[:500],
+            "phase": attempt["phase"],
+            "retryable": retryable_error(attempt["phase"], err),
+            "resumable": provenance(),
+            "lint": lint_stamp(),
+        }))
+        return 0
+
+
 def _write_status(path, phase):
     """Phase marker + compile ledger for the parent: when the bounded
     subprocess dies mid-measurement, the last snapshot says whether the
@@ -321,6 +588,9 @@ def main():
     import os
     import subprocess
 
+    if "--resumable" in sys.argv:
+        argv = [a for a in sys.argv[1:] if a != "--resumable"]
+        sys.exit(resumable_main(argv))
     if "--one" in sys.argv:
         print(json.dumps(_measure()))
         return
@@ -382,6 +652,13 @@ def main():
         "vs_baseline": 0.0,
         "error": str(last_err),
         "phase": status.get("phase", "unknown"),
+        # phase-aware transient/permanent classification: the watcher
+        # backs off and retries ONLY on retryable errors — an unknown
+        # phase means the child died before its first marker, i.e. in
+        # backend territory, which classifies retryable via the
+        # signature list
+        "retryable": retryable_error(
+            status.get("phase", "backend_init"), str(last_err)),
         "compile_seconds": status.get("compile_seconds", 0.0),
         "cache_hits": status.get("cache_hits", 0),
         "cache_misses": status.get("cache_misses", 0),

@@ -140,6 +140,23 @@ while tpu_client_inflight; do
     sleep 60
 done
 
+# EXPONENTIAL BACKOFF on availability failures (replaces the old fixed
+# 120 s probe / 300 s attempt sleeps): consecutive backend-unavailable
+# outcomes double the pause 60 s -> 960 s cap — a long outage is polled
+# gently, while any sign of progress (probe success, a warmed attempt)
+# resets to 60 s so a fresh grant window is exploited immediately.
+# NON-retryable errors (bench.py's phase-aware "retryable": false —
+# a real code failure, not the chip) stop the loop outright: hammering
+# the queue cannot fix those and only burns grant windows.
+BACKOFF=60
+BACKOFF_CAP=960
+backoff_sleep() {
+    echo "[$(stamp)] watch: backing off ${BACKOFF}s"
+    sleep "$BACKOFF"
+    BACKOFF=$(( BACKOFF * 2 ))
+    [ "$BACKOFF" -gt "$BACKOFF_CAP" ] && BACKOFF=$BACKOFF_CAP
+}
+
 attempt=0
 while :; do
     if [ -e "$OUT/.stop" ]; then
@@ -154,24 +171,36 @@ while :; do
     fi
     attempt=$((attempt + 1))
     # cheap bounded pre-probe: a ~2-min jax.devices() ping answers "is
-    # the chip granting AT ALL?" before committing a 2400 s bench bound.
-    # Short grant windows used to be missed because a dead-chip attempt
-    # sat in TPU init for its full 2400 s timeout (one probe-able window
-    # per ~40 min); with the gate, dead attempts cost ~2 min and the
-    # watcher re-probes ~13x more often.  The full attempt launches only
-    # on probe success (and must still fit the deadline on its own).
+    # the chip granting AT ALL?" before committing a full bench bound.
+    # Dead attempts cost ~2 min instead of the full timeout, so short
+    # grant windows are probed often; the full attempt launches only on
+    # probe success (and must still fit the deadline on its own).
     echo "[$(stamp)] watch: probe attempt $attempt (120s jax.devices ping)"
     if ! timeout -k 10 120 python -c 'import jax; print(jax.devices())' \
             >> "$OUT/watch.err" 2>&1; then
-        echo "[$(stamp)] watch: probe $attempt found no granting chip; retrying in 120s"
-        sleep 120
+        echo "[$(stamp)] watch: probe $attempt found no granting chip"
+        backoff_sleep
         continue
     fi
-    echo "[$(stamp)] watch: probe $attempt SUCCESS; launching full bench attempt (bound ${ATTEMPT_BOUND}s)"
+    BACKOFF=60  # the chip is granting: poll eagerly again
+    echo "[$(stamp)] watch: probe $attempt SUCCESS; launching resumable bench attempt (bound ${ATTEMPT_BOUND}s)"
     _t0=$(date +%s)
-    timeout "$ATTEMPT_BOUND" python bench.py --one > "$OUT/.try.json" 2>> "$OUT/watch.err"
+    # the resumable state machine makes every attempt's progress
+    # durable: attempt N warms the compile cache + serializes the AOT
+    # executable, attempt N+1 measures a handful of steps off them —
+    # the first green number no longer needs one attempt to survive
+    # the whole cold start inside one grant window
+    timeout "$ATTEMPT_BOUND" python bench.py --resumable \
+        --ledger "$OUT/resumable.json" --budget $(( ATTEMPT_BOUND - 60 )) \
+        > "$OUT/.try.json" 2>> "$OUT/watch.err"
     rc=$?
-    if [ "$rc" = 0 ] && grep -q '"value"' "$OUT/.try.json" 2>/dev/null; then
+    if [ "$rc" = 0 ] && grep -q '"warmed": true' "$OUT/.try.json" 2>/dev/null; then
+        echo "[$(stamp)] watch: attempt $attempt WARMED the caches; measuring next"
+        cat "$OUT/.try.json" >> "$OUT/bench.jsonl"
+        continue  # progress, not failure: no backoff
+    fi
+    if [ "$rc" = 0 ] && ! grep -q '"error"' "$OUT/.try.json" 2>/dev/null \
+            && grep -q '"value"' "$OUT/.try.json" 2>/dev/null; then
         echo "[$(stamp)] watch: SUCCESS on attempt $attempt"
         # record the observed compile+measure duration: it informs the
         # NEXT attempt bound (this watcher run and restarts alike)
@@ -181,6 +210,11 @@ while :; do
         break
     fi
     echo "[$(stamp)] watch: attempt $attempt failed rc=$rc ($(tail -c 200 "$OUT/watch.err" | tr '\n' ' '))"
+    if grep -q '"retryable": false' "$OUT/.try.json" 2>/dev/null; then
+        echo "[$(stamp)] watch: NON-RETRYABLE failure (see $OUT/.try.json); stopping — fix the code, not the chip"
+        cat "$OUT/.try.json" >> "$OUT/bench.jsonl"
+        exit 1
+    fi
     if [ "$rc" = 124 ] && [ "$ATTEMPT_BOUND" -lt "$BOUND_CAP" ]; then
         # the warm-derived bound killed a (re-)cold attempt — e.g. a
         # jaxlib upgrade rotated the compile-cache namespace.  Forget
@@ -190,7 +224,7 @@ while :; do
         rm -f "$OUT/.last_attempt_secs"
         ATTEMPT_BOUND=$BOUND_CAP
     fi
-    sleep 300
+    backoff_sleep
 done
 
 # chip is granting: run the rest of the staged chain (stage 1 re-runs

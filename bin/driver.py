@@ -198,6 +198,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "to 503 when no step lands inside it; eval and "
                         "checkpoint phases are exempt). 0 disables the "
                         "watchdog")
+    p.add_argument("--fault-plan", default=None, metavar="JSON",
+                   help="install a deterministic fault-injection plan "
+                        "(fluxdistributed_tpu.faults) before anything "
+                        "else runs — chaos/testing harness.  JSON object "
+                        "or @path/to/plan.json, e.g. "
+                        "'{\"sigterm_at_step\": 50}' proves the "
+                        "checkpoint-on-SIGTERM path, "
+                        "'{\"params\": {\"local_devices\": 4}}' simulates "
+                        "a device-count change on resume")
     # manual cluster bring-up (CPU fake cluster / debugging)
     p.add_argument("--coordinator", default=None, help="coordinator host:port")
     p.add_argument("--num-processes", type=int, default=None)
@@ -210,6 +219,24 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    from fluxdistributed_tpu import faults
+
+    if args.fault_plan:
+        import json
+
+        spec = args.fault_plan
+        if spec.startswith("@"):
+            with open(spec[1:]) as f:
+                spec = f.read()
+        faults.install_plan(faults.FaultPlan.from_spec(json.loads(spec)))
+        # a plan can simulate a device-count change on resume: the next
+        # grant window handing back a different slice is modeled by
+        # overriding the virtual-device count before backend init
+        override = faults.param("local_devices")
+        if override is not None:
+            args.local_devices = int(override)
+            args.platform = args.platform or "cpu"
 
     # Distributed init MUST precede any backend use.
     from fluxdistributed_tpu.parallel import multihost
@@ -482,12 +509,15 @@ def main(argv=None) -> int:
     )
 
     if args.resume and args.checkpoint_dir:
-        from fluxdistributed_tpu.train import latest_step, load_checkpoint
+        from fluxdistributed_tpu.train import resume_training
 
-        if latest_step(args.checkpoint_dir) is not None:
-            task.state = load_checkpoint(args.checkpoint_dir, task.state, mesh=mesh)
-            if multihost.is_coordinator():
-                print(f"resumed from step {int(task.state.step)}")
+        manifest = resume_training(task, args.checkpoint_dir)
+        if multihost.is_coordinator() and (
+                manifest is not None or int(task.state.step)):
+            src = ("RESUME manifest" if manifest is not None
+                   else "latest checkpoint (no manifest)")
+            print(f"resumed from step {int(task.state.step)} at item "
+                  f"{getattr(task.loader, 'start', 0)} via {src}")
 
     if args.wandb:
         from fluxdistributed_tpu.train.logging import WandbLogger
@@ -557,7 +587,17 @@ def main(argv=None) -> int:
             checkpoint_every=args.checkpoint_every,
             verbose=args.verbose,
             observation=observation,
+            handle_signals=True,
         )
+    except faults.Preempted as e:
+        # checkpoint + RESUME manifest are already durably on disk;
+        # the DISTINCT exit code tells a supervisor "requeue me with
+        # --resume", unlike 0 (done) or 1 (crashed)
+        if multihost.is_coordinator():
+            print(f"preempted: {e} — resume with --resume "
+                  f"--checkpoint-dir {args.checkpoint_dir} "
+                  f"(exit code {faults.PREEMPTED_RC})")
+        return faults.PREEMPTED_RC
     finally:
         if metrics_srv is not None:
             metrics_srv.stop()

@@ -146,6 +146,12 @@ def build_parser():
     p.add_argument("--mlp", default="gelu", choices=["gelu", "swiglu"],
                    help="match the trainer's --mlp")
     # cold-start controls (fluxdistributed_tpu.compilation)
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="graceful-drain bound for --lm: on SIGTERM the "
+                        "server stops admissions (503), finishes "
+                        "in-flight decodes for up to this many seconds "
+                        "(healthz reports draining), then exits 0 — "
+                        "kube-style rolling restarts lose no tokens")
     p.add_argument("--prewarm", action="store_true",
                    help="pre-compile every prefill bucket, the splice "
                         "and the all-slot decode step BEFORE binding the "
@@ -348,8 +354,13 @@ def main(argv=None) -> int:
     if args.lm:
         lm_server, _ = make_lm_app(args)
         srv = lm_server.serve(args.host, args.port)
+        # SIGTERM → stop admissions, finish in-flight decodes (bounded),
+        # shut the HTTP server down, exit 0 — the graceful-drain path
+        lm_server.install_drain_handler(httpd=srv,
+                                        timeout=args.drain_timeout)
         print(f"serving LM on http://{args.host}:{srv.server_address[1]}/"
-              f"v1/generate (ctrl-c to stop)")
+              f"v1/generate (ctrl-c to stop; SIGTERM drains "
+              f"<= {args.drain_timeout:.0f}s)")
         try:
             srv.serve_forever()
         except KeyboardInterrupt:

@@ -17,6 +17,7 @@ from . import compat  # noqa: F401 — must precede any jax-surface use
 from . import (
     compilation,
     data,
+    faults,
     mesh,
     models,
     obs,

@@ -102,6 +102,14 @@ class PrefetchLoader:
     transform: optional host-side hook, called per the dataset protocol:
         ``transform(imgs, labels)`` for tuple datasets, ``transform(out)``
         (one argument) for dict / bare-array datasets
+    start: first item index to yield (resume cursor).  Batch content is
+        a pure function of ``(seed, process, index)``, so a resumed run
+        starting at the preempted run's ``next_item`` sees byte-identical
+        batches from there on — the loss-parity contract
+        (docs/robustness.md)
+    retries: transient host-side assembly failures (I/O hiccups in a
+        real decode pipeline; injected faults in tests) are retried this
+        many times per batch before surfacing to the consumer
     """
 
     def __init__(
@@ -118,6 +126,8 @@ class PrefetchLoader:
         num_threads: int = 2,
         transform: Optional[Callable] = None,
         chunk: int = 1,
+        start: int = 0,
+        retries: int = 2,
     ):
         n = mesh.shape[axis]
         if batch_size % n:
@@ -141,6 +151,10 @@ class PrefetchLoader:
         # unchunked run (same rng derivation), so chunking never changes
         # what the model sees, only how many dispatches feed it.
         self.chunk = chunk
+        if start < 0:
+            raise ValueError(f"start must be >= 0, got {start}")
+        self.start = start
+        self.retries = max(0, retries)
         self.sharding = NamedSharding(mesh, P(axis))
         self._chunk_sharding = NamedSharding(mesh, P(None, axis))
         # observability: queue depth + h2d timing land in the process
@@ -197,6 +211,9 @@ class PrefetchLoader:
         # assembles which batch.  Distinct per process, so hosts sample
         # different rows (the analog of the reference's per-worker
         # sampling, src/sync.jl:135).
+        from .. import faults
+
+        faults.fire("loader", index=i)
         rng = np.random.default_rng((self.seed, jax.process_index(), i))
         out = self.dataset.batch(rng, self._local_batch)
         return apply_transform(self.transform, out)
@@ -235,8 +252,14 @@ class PrefetchLoader:
         return self.cycles // self.chunk
 
     def __iter__(self) -> Iterator[dict]:
+        from .. import faults
+
+        if self.start > len(self):
+            raise ValueError(
+                f"start item {self.start} is past the end of the run "
+                f"({len(self)} items) — a stale RESUME manifest?")
         q: queue.Queue = queue.Queue(maxsize=self.buffersize)
-        counter = iter(range(len(self)))
+        counter = iter(range(self.start, len(self)))
         lock = threading.Lock()
         stop = threading.Event()
 
@@ -260,7 +283,14 @@ class PrefetchLoader:
                     # the consumer's compute, like the reference's
                     # prefetch tasks
                     t0 = time.perf_counter()
-                    host = self._make_item(i)
+                    # transient assembly failures (real I/O or injected
+                    # via the fault plan) cost a short backoff, not the
+                    # run; batch content is index-pure so a retry is
+                    # bit-identical
+                    host = faults.with_retries(
+                        lambda: self._make_item(i),
+                        tries=self.retries + 1, backoff=0.05,
+                        site="loader")
                     t1 = time.perf_counter()
                     self._m_assemble.observe(t1 - t0)
                     tracer = self.tracer
@@ -293,7 +323,7 @@ class PrefetchLoader:
         # Deliver strictly in batch-index order (threads may finish out of
         # order): determinism costs only a small reorder buffer.
         pending: dict = {}
-        next_idx = 0
+        next_idx = self.start
         try:
             while next_idx < len(self):
                 while next_idx not in pending:

@@ -55,6 +55,7 @@ __all__ = [
     "broadcast_from_coordinator",
     "sync_global_devices",
     "agree_to_stop",
+    "commit_to_mesh",
 ]
 
 
@@ -227,6 +228,31 @@ def sync_global_devices(tag: str = "barrier") -> None:
 
     if jax.process_count() > 1:
         multihost_utils.sync_global_devices(tag)
+
+
+def commit_to_mesh(x, like) -> jax.Array:
+    """Commit a HOST array to the sharding of ``like`` (a device array,
+    a ``NamedSharding``, or anything exposing ``.sharding``) — the
+    elastic-resume boundary: a checkpoint saved on one topology is
+    restored to host arrays and re-committed, leaf by leaf, to the NEW
+    mesh's shardings (``train.checkpoint.load_checkpoint_elastic``).
+
+    Multi-host safe: each process materializes only its addressable
+    shards (``jax.make_array_from_callback`` slices the host copy per
+    shard), so a replicated-everywhere host value never round-trips
+    through a single device.
+    """
+    from jax.sharding import Sharding
+
+    sharding = like if isinstance(like, Sharding) else getattr(
+        like, "sharding", None)
+    x = np.asarray(x)
+    if sharding is None:
+        return jax.device_put(x)
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_callback(
+        x.shape, sharding, lambda idx: x[idx])
 
 
 def agree_to_stop(local_stop: bool) -> bool:

@@ -6,7 +6,7 @@ a fixed pool of compiled XLA programs."""
 
 from .cache_layout import BlockPool, DenseLayout, PagedLayout
 from .engine import DEFAULT_BUCKETS, DEFAULT_KV_BLOCK_SIZE, LMEngine
-from .scheduler import QueueFull, Request, Scheduler
+from .scheduler import Draining, QueueFull, Request, Scheduler
 from .server import LMServer, serve_lm
 
 __all__ = [
@@ -14,6 +14,7 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "DEFAULT_KV_BLOCK_SIZE",
     "DenseLayout",
+    "Draining",
     "LMEngine",
     "LMServer",
     "PagedLayout",

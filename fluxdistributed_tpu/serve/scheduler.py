@@ -49,7 +49,7 @@ import numpy as np
 from ..obs.metrics import Registry
 from .engine import LMEngine
 
-__all__ = ["Request", "Scheduler", "QueueFull"]
+__all__ = ["Request", "Scheduler", "QueueFull", "Draining"]
 
 # every serving series carries this prefix in Prometheus exposition;
 # Scheduler.metrics() returns the same series WITHOUT it (the dict API
@@ -61,6 +61,12 @@ _ids = itertools.count()
 
 class QueueFull(RuntimeError):
     """Admission queue at capacity — shed load (HTTP 429)."""
+
+
+class Draining(RuntimeError):
+    """Server is draining for shutdown — new admissions refused (HTTP
+    503: unlike 429/QueueFull, retrying THIS instance is pointless;
+    a load balancer should route elsewhere)."""
 
 
 @dataclass
@@ -117,6 +123,10 @@ class Scheduler:
         #: raise it to favor prompt ingestion over decode latency
         self.prefill_chunks_per_tick = prefill_chunks_per_tick
         self._rr = -1  # round-robin cursor over prefilling slots
+        #: graceful-drain latch (see :meth:`begin_drain`): True refuses
+        #: NEW submissions while everything already accepted (queued or
+        #: in a slot) runs to completion
+        self.draining = False
         self._queue: deque[Request] = deque()
         self._lock = threading.Lock()
         self._work = threading.Event()
@@ -234,11 +244,27 @@ class Scheduler:
 
     # ---- producer side (any thread) ---------------------------------------
 
+    def begin_drain(self) -> None:
+        """Stop admissions for graceful shutdown.  Requests already
+        accepted (queued or decoding) run to completion — bounding that
+        is the caller's job (:meth:`LMServer.drain`'s timeout)."""
+        self.draining = True
+        self.registry.gauge(
+            "fdtpu_serve_draining",
+            "1 while the scheduler refuses new admissions for shutdown",
+        ).set(1)
+        self._work.set()
+
     def submit(self, req: Request) -> Request:
-        """Validate + enqueue; raises ``ValueError`` (bad shape) or
-        :class:`QueueFull` (backpressure)."""
+        """Validate + enqueue; raises ``ValueError`` (bad shape),
+        :class:`QueueFull` (backpressure) or :class:`Draining`
+        (shutting down)."""
         self.engine.validate_request(len(req.prompt), req.max_new_tokens)
         with self._lock:
+            if self.draining:
+                self._c_rejected.inc()
+                raise Draining(
+                    "server is draining for shutdown; route elsewhere")
             if len(self._queue) >= self.max_queue:
                 self._c_rejected.inc()
                 raise QueueFull(

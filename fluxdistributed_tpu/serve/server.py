@@ -31,9 +31,10 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Optional
 
-from .scheduler import QueueFull, Request, Scheduler
+from .scheduler import Draining, QueueFull, Request, Scheduler
 
 __all__ = ["LMServer", "serve_lm"]
 
@@ -72,6 +73,70 @@ class LMServer:
             self._loop_thread.join(timeout=10)
             self._loop_thread = None
         self._stop.clear()
+
+    # ---- graceful drain ---------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self.scheduler.draining
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful shutdown, SIGTERM-shaped: stop admissions (new
+        submits get 503), let everything already accepted finish —
+        bounded by ``timeout`` seconds — then stop the engine loop.
+        ``/healthz`` reports 503 with ``"draining": true`` for the
+        whole window, so a load balancer pulls this replica while
+        in-flight decodes complete.
+
+        Returns True when the drain finished clean (scheduler idle);
+        False when the timeout cut it short — undone requests' clients
+        see their own request timeouts, not silent token loss.
+        """
+        self.scheduler.begin_drain()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.scheduler.idle:
+                break
+            time.sleep(0.02)
+        drained = self.scheduler.idle
+        self.stop_loop()
+        return drained
+
+    def install_drain_handler(self, httpd=None, timeout: float = 30.0,
+                              signals=None):
+        """Install SIGTERM (and optionally more) handlers that run
+        :meth:`drain` on a background thread — a signal handler must
+        return immediately — and then ``shutdown()`` the HTTP server so
+        ``serve_forever`` returns and the process exits 0.  Returns the
+        :class:`~..faults.SignalFlag`-style uninstaller (callable) so
+        tests can restore previous handlers."""
+        import signal as _signal
+
+        signals = tuple(signals) if signals is not None else (
+            _signal.SIGTERM,)
+        previous = {}
+
+        def _drain_then_shutdown():
+            self.drain(timeout)
+            if httpd is not None:
+                httpd.shutdown()
+
+        def handler(signum, frame):
+            threading.Thread(
+                target=_drain_then_shutdown, name="lm-drain",
+                daemon=True).start()
+
+        for s in signals:
+            previous[s] = _signal.signal(s, handler)
+
+        def uninstall():
+            for s, old in previous.items():
+                try:
+                    _signal.signal(s, old)
+                except (ValueError, OSError):
+                    pass
+
+        return uninstall
 
     def close(self) -> None:
         """Full teardown: stop the engine loop and detach this server's
@@ -174,8 +239,13 @@ class LMServer:
                     sched = outer.scheduler
                     loop = outer._loop_thread
                     alive = loop is not None and loop.is_alive()
+                    draining = sched.draining
                     body = {
-                        "ok": alive,
+                        # a draining replica is deliberately unhealthy:
+                        # the load balancer must pull it while in-flight
+                        # decodes finish
+                        "ok": alive and not draining,
+                        "draining": draining,
                         "active_slots": sched.active_slots,
                         "max_slots": sched.engine.max_slots,
                         "queue_depth": sched.queue_depth,
@@ -183,7 +253,8 @@ class LMServer:
                     }
                     if outer.last_loop_error:
                         body["last_loop_error"] = outer.last_loop_error
-                    self._send_json(200 if alive else 503, body)
+                    self._send_json(
+                        200 if (alive and not draining) else 503, body)
                 elif self.path == "/metrics":
                     self._send(200, outer.metrics_text().encode(),
                                "text/plain; version=0.0.4")
@@ -215,6 +286,11 @@ class LMServer:
                 try:
                     outer.scheduler.submit(req)
                     return True
+                except Draining as e:
+                    # 503 (not 429): retrying this instance is
+                    # pointless, route to another replica
+                    self._send_json(503, {"error": str(e),
+                                          "draining": True})
                 except QueueFull as e:
                     self.send_response(429)
                     self.send_header("Retry-After", "1")

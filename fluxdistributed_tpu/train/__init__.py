@@ -1,6 +1,22 @@
 from .logging import ConsoleLogger, Logger, NullLogger, current_logger, with_logger
-from .trainer import TrainTask, evaluate, prepare_training, restore_training, train
-from .checkpoint import latest_step, load_checkpoint, save_checkpoint, wait_for_pending
+from .trainer import (
+    TrainTask,
+    evaluate,
+    prepare_training,
+    restore_training,
+    resume_training,
+    train,
+)
+from .checkpoint import (
+    clear_resume_manifest,
+    latest_step,
+    load_checkpoint,
+    load_checkpoint_elastic,
+    read_resume_manifest,
+    save_checkpoint,
+    wait_for_pending,
+    write_resume_manifest,
+)
 from .model_selection import (
     SelectionTask,
     prepare_model_selection,
@@ -17,11 +33,16 @@ __all__ = [
     "evaluate",
     "prepare_training",
     "restore_training",
+    "resume_training",
     "train",
     "save_checkpoint",
     "wait_for_pending",
     "load_checkpoint",
+    "load_checkpoint_elastic",
     "latest_step",
+    "read_resume_manifest",
+    "write_resume_manifest",
+    "clear_resume_manifest",
     "SelectionTask",
     "prepare_model_selection",
     "train_model_selection",

@@ -7,17 +7,34 @@ no resume path (SURVEY §5).  This module closes that gap TPU-natively:
 * ``save_checkpoint`` — orbax-backed save of the FULL ``TrainState``
   (params + optimizer state + mutable model state + step), written
   per-step under ``<dir>/step_<n>`` like the reference's
-  ``weights/$(p)/resnet_50_cycle_$(n)...`` layout;
+  ``weights/$(p)/resnet_50_cycle_$(n)...`` layout.  Writes are
+  ATOMIC: orbax streams into a ``step_<n>.tmp.<pid>`` staging dir
+  which is renamed into place only once fully on disk, so a ``kill
+  -9`` (or a preemption) mid-write can never leave ``latest_step``
+  pointing at a half-written checkpoint — the previous one stays
+  loadable (docs/robustness.md);
 * ``load_checkpoint`` — restore onto host or onto a mesh (replicated),
   defaulting to the latest step — the resume path the reference lacks;
-* ``latest_step`` — scan a checkpoint dir.
+* ``load_checkpoint_elastic`` — restore a checkpoint saved on a
+  DIFFERENT topology: leaves round-trip through host arrays and are
+  re-committed to the restoring task's shardings, with ZeRO-1's padded
+  per-leaf flat shards re-split for the new device count;
+* ``latest_step`` — scan a checkpoint dir;
+* ``write_resume_manifest`` / ``read_resume_manifest`` — the RESUME
+  manifest a preempted run leaves next to its checkpoint (step,
+  data-loader cursor, rng derivation note, mesh topology) so the next
+  process can continue step-for-step identically.
 
 Orbax handles sharded arrays natively, so the same call works on a
 multi-host pod slice (each host writes its addressable shards).
+Checkpoint I/O is wrapped in :func:`fluxdistributed_tpu.faults.
+with_retries` (single-process runs), so a transient filesystem hiccup
+costs a backoff instead of the run.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import threading
@@ -27,15 +44,30 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from .. import faults
 from .. import tree as tree_lib
 
 Pytree = Any
 
-__all__ = ["save_checkpoint", "load_checkpoint", "latest_step", "wait_for_pending"]
+__all__ = [
+    "save_checkpoint",
+    "load_checkpoint",
+    "load_checkpoint_elastic",
+    "latest_step",
+    "wait_for_pending",
+    "RESUME_MANIFEST",
+    "write_resume_manifest",
+    "read_resume_manifest",
+    "clear_resume_manifest",
+]
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
-# Checkpointers with an async write still in flight (block=False saves).
+#: filename of the preemption manifest inside a checkpoint directory
+RESUME_MANIFEST = "RESUME.json"
+
+# (checkpointer, commit) pairs with an async write still in flight
+# (block=False saves); commit publishes the staging dir once finished.
 # At most one at a time: save_checkpoint drains it before starting the
 # next, and train()/callers drain at exit via wait_for_pending().  The
 # expected owner is a single train loop per process; the locks make a
@@ -54,17 +86,75 @@ def _step_dir(directory: str, step: int) -> str:
 
 
 def wait_for_pending() -> None:
-    """Block until any in-flight async save has committed to disk.
+    """Block until any in-flight async save has committed to disk —
+    including the atomic staging-dir → ``step_<n>`` rename, which only
+    happens once the write is fully finished.
 
-    Single-threaded savers assumed (one train loop per process — the
-    module-global ``_PENDING`` is not lock-protected).  The pending
-    reference is removed only after a successful wait, so a failed wait
-    leaves it in place and a retry can still await the write.
+    Single-threaded savers assumed (one train loop per process).  The
+    pending reference is removed only after a successful wait+commit,
+    so a failed wait leaves it in place and a retry can still await
+    the write.
     """
     with _PENDING_LOCK:
         while _PENDING:
-            _PENDING[-1].wait_until_finished()
-            _PENDING.pop()
+            ckptr, commit = _PENDING[-1]
+            # a failed WAIT leaves the entry (a retry can still await
+            # the write); a failed COMMIT drops it — its staging dir is
+            # gone, so re-running the same commit could only raise
+            # forever and wedge every subsequent save
+            ckptr.wait_until_finished()
+            try:
+                commit()
+            finally:
+                _PENDING.pop()
+
+
+def _barrier(tag: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
+def _commit_rename(tmp: str, final: str) -> None:
+    """The atomic-publish rename, isolated so the kill -9 atomicity
+    test can interpose on exactly this boundary."""
+    os.rename(tmp, final)
+
+
+def _commit(tmp: str, final: str, overwrite: bool) -> None:
+    """Publish a fully-written staging dir as ``step_<n>``.
+
+    The rename runs on the coordinator behind barriers.  The previous
+    content of ``final`` (a same-step re-save) is moved aside BEFORE the
+    publish rename and deleted after, so at every instant either the old
+    or the new complete checkpoint exists under a committed name — never
+    a partial one.  Other steps' directories are never touched.
+
+    The overwrite=False refusal is decided on EVERY host (same shared
+    checkpoint filesystem, same answer) and raised on every host AFTER
+    the final barrier — a coordinator-only raise between the barriers
+    would strand the other hosts in ``ckpt_commit`` forever.
+    """
+    import shutil
+
+    _barrier("ckpt_written")
+    refused = not overwrite and os.path.exists(final)
+    if jax.process_index() == 0:
+        if refused:
+            shutil.rmtree(tmp, ignore_errors=True)
+        else:
+            trash = None
+            if os.path.exists(final):
+                trash = f"{final}.old.{os.getpid()}"
+                os.rename(final, trash)
+            _commit_rename(tmp, final)
+            if trash is not None:
+                shutil.rmtree(trash, ignore_errors=True)
+    _barrier("ckpt_commit")
+    if refused:
+        raise FileExistsError(
+            f"checkpoint {final} exists and overwrite=False")
 
 
 def save_checkpoint(
@@ -73,37 +163,73 @@ def save_checkpoint(
 ) -> str:
     """Write ``state`` (any pytree, e.g. ``TrainState``) at ``directory/step_<n>``.
 
+    Write-then-rename: orbax streams into ``step_<n>.tmp.<pid>`` and the
+    staging dir is renamed to ``step_<n>`` only once fully written — a
+    process killed mid-write (kill -9, an expired grant window) leaves
+    only staging garbage behind, never a half-checkpoint that
+    :func:`latest_step` would resume from.  Stale staging dirs from a
+    previous dead process are swept on the next save.
+
     ``block=False`` makes the disk write asynchronous: orbax's save copies
     device arrays to host synchronously (so later donation/mutation of the
     state cannot corrupt the snapshot) and streams to disk in a background
-    thread — the train loop keeps stepping during the write.  Call
-    :func:`wait_for_pending` (train() does) before relying on the file.
+    thread — the train loop keeps stepping during the write, and the
+    publish rename happens at the next :func:`wait_for_pending` (train()
+    drains before exit).
 
     Multi-host: the orbax save itself is collective (every host writes its
-    addressable shards), but the pre-delete of an existing step dir runs
-    on the coordinator only, behind a barrier — concurrent ``rmtree`` from
-    N hosts on a shared filesystem would race the save.
+    addressable shards); the publish rename runs on the coordinator only,
+    behind barriers.  Transient I/O failures are retried
+    (:func:`..faults.with_retries`) on single-process runs — a multi-host
+    retry cannot be coordinated one-sidedly.
     """
-    with _SAVE_LOCK:  # one save (drain → write → append) at a time
+    import shutil
+
+    with _SAVE_LOCK:  # one save (drain → write → commit/append) at a time
         wait_for_pending()
-        path = _step_dir(directory, step)
+        final = _step_dir(directory, step)
+        # pid-FREE staging name: the orbax save below is COLLECTIVE, so
+        # every host of a multi-host run must aim at the same directory
+        # (a per-pid name would scatter shards across one dir per host).
+        # Unowned staging dirs are impossible here — the pending list
+        # was just drained and _SAVE_LOCK serializes savers — so any
+        # pre-existing one is garbage the sweep below removes.
+        tmp = f"{final}.tmp.stage"
         ckptr = ocp.StandardCheckpointer()
-        if overwrite and os.path.exists(path):
-            if jax.process_index() == 0:
-                import shutil
+        if jax.process_index() == 0:
+            os.makedirs(os.path.abspath(directory), exist_ok=True)
+            # sweep staging garbage: ours from a retry, or a dead
+            # predecessor's (an unowned staging dir can never be
+            # committed — the pending list above was just drained)
+            for name in os.listdir(os.path.abspath(directory)):
+                for marker in (".tmp.", ".old."):
+                    stem, sep, _ = name.partition(marker)
+                    if sep and _STEP_RE.match(stem):
+                        shutil.rmtree(
+                            os.path.join(os.path.abspath(directory), name),
+                            ignore_errors=True)
+                        break
+        _barrier("ckpt_stage")
 
-                shutil.rmtree(path, ignore_errors=True)
-            if jax.process_count() > 1:
-                from jax.experimental import multihost_utils
+        def write():
+            faults.fire("checkpoint_save")
+            if os.path.exists(tmp):  # partial write from a failed attempt
+                shutil.rmtree(tmp, ignore_errors=True)
+            ckptr.save(tmp, state)
 
-                multihost_utils.sync_global_devices("ckpt_rmtree")
-        ckptr.save(path, state)
+        if jax.process_count() == 1:
+            faults.with_retries(
+                write, tries=3, backoff=0.2, site="checkpoint_save",
+                retryable=lambda e: isinstance(e, (OSError, IOError)))
+        else:
+            write()
         if block:
             ckptr.wait_until_finished()
+            _commit(tmp, final, overwrite)
         else:
             with _PENDING_LOCK:
-                _PENDING.append(ckptr)
-    return path
+                _PENDING.append((ckptr, lambda: _commit(tmp, final, overwrite)))
+    return final
 
 
 def latest_step(directory: str) -> Optional[int]:
@@ -144,6 +270,20 @@ def load_checkpoint(
             raise FileNotFoundError(f"no checkpoints under {directory}")
     path = _step_dir(directory, step)
     ckptr = ocp.StandardCheckpointer()
+
+    def _read(fn):
+        """Transient-I/O retry boundary for the orbax reads (single
+        process only — a multi-host retry cannot be coordinated
+        one-sidedly)."""
+        def attempt():
+            faults.fire("checkpoint_load")
+            return fn()
+
+        if jax.process_count() > 1:
+            return attempt()
+        return faults.with_retries(
+            attempt, tries=3, backoff=0.2, site="checkpoint_load",
+            retryable=lambda e: isinstance(e, (OSError, IOError)))
     if target is None:
         # Build a host-numpy target from the saved metadata instead of
         # restoring blind: a blind restore re-applies the SAVED device
@@ -158,7 +298,7 @@ def load_checkpoint(
             lambda m: np.zeros(m.shape, m.dtype) if hasattr(m, "shape") else m,
             meta,
         )
-        restored = ckptr.restore(path, target=target)
+        restored = _read(lambda: ckptr.restore(path, target=target))
         if mesh is not None:
             from ..sharding import replicate
 
@@ -184,8 +324,145 @@ def load_checkpoint(
                 return jax.ShapeDtypeStruct(np.shape(t), t.dtype, sharding=sh)
             return t
 
-        return ckptr.restore(path, target=jax.tree.map(abstract, target))
+        return _read(
+            lambda: ckptr.restore(path, target=jax.tree.map(abstract, target)))
 
-    return ckptr.restore(
+    return _read(lambda: ckptr.restore(
         path, target=jax.tree.map(np.asarray, tree_lib.to_host(target))
-    )
+    ))
+
+
+# ---------------------------------------------------------------------------
+# elastic restore (device-count change between save and resume)
+# ---------------------------------------------------------------------------
+
+
+def _path_key(entry) -> str:
+    """Normalize one jax key-path entry to a plain string so a saved
+    nested-dict tree (orbax metadata: everything string-keyed) and a
+    live ``TrainState`` (attr/dict/tuple keys) address leaves
+    identically."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def _leaves_by_path(tree) -> dict:
+    from jax.tree_util import tree_flatten_with_path
+
+    flat, _ = tree_flatten_with_path(tree)
+    return {tuple(_path_key(k) for k in path): leaf for path, leaf in flat}
+
+
+def load_checkpoint_elastic(
+    directory: str, target: Pytree, step: Optional[int] = None
+) -> Pytree:
+    """Restore a checkpoint onto ``target`` when the saving topology
+    differs from the restoring one (preemption returned a different
+    device count — the elastic-resume path, ROADMAP Open item 5).
+
+    Protocol: the checkpoint is restored to HOST arrays
+    (topology-independently, via the saved metadata), leaves are matched
+    to ``target``'s by tree path, adapted where the layout is
+    device-count-dependent, and committed to each target leaf's
+    sharding on the new mesh
+    (:func:`..parallel.multihost.commit_to_mesh`).
+
+    The one device-count-dependent layout in the framework is ZeRO-1's
+    flattened-padded optimizer state: each leaf is 1-D, zero-padded to
+    a multiple of the data-axis size N (``parallel/zero1.py``).  On a
+    device-count change the pad length changes, so saved flat leaves
+    are trimmed/re-padded to the target's length — sound because the
+    pad region is identically zero and inert through every elementwise
+    update rule (both lengths are >= the real entry count, so no real
+    entry is ever cut).  dp (replicated) and fsdp (full global shapes,
+    per-leaf shardings) need no adaptation beyond the re-commit.
+    """
+    from ..parallel.multihost import commit_to_mesh
+
+    faults.fire("resume")
+    saved = load_checkpoint(directory, target=None, step=step)
+    saved_leaves = _leaves_by_path(saved)
+    target_leaves = _leaves_by_path(target)
+    missing = set(target_leaves) - set(saved_leaves)
+    if missing:
+        raise ValueError(
+            f"checkpoint at {directory} lacks {len(missing)} leaves the "
+            f"restoring state needs (e.g. {sorted(missing)[:3]}) — was it "
+            "saved by a different model/optimizer configuration?")
+
+    def adapt(path, t):
+        s = np.asarray(saved_leaves[path])
+        tshape = tuple(np.shape(t))
+        if s.shape != tshape:
+            if s.ndim == 1 and len(tshape) == 1:
+                # ZeRO-1 flat-padded slot: re-split for the new device
+                # count (trim surplus old pad / add new pad — zeros
+                # both ways)
+                n = min(s.shape[0], tshape[0])
+                out = np.zeros(tshape, s.dtype)
+                out[:n] = s[:n]
+                s = out
+            else:
+                raise ValueError(
+                    f"leaf {'/'.join(path)}: saved shape {s.shape} cannot "
+                    f"be adapted to {tshape} — only 1-D (flat-padded "
+                    "ZeRO-1) leaves are device-count-dependent; a "
+                    "different model/optimizer cannot resume elastically")
+        dtype = getattr(t, "dtype", None)
+        if dtype is not None and s.dtype != dtype:
+            s = s.astype(dtype)
+        return commit_to_mesh(s, t)
+
+    from jax.tree_util import tree_flatten_with_path, tree_unflatten
+
+    flat, treedef = tree_flatten_with_path(target)
+    out = [adapt(tuple(_path_key(k) for k in path), t) for path, t in flat]
+    return tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# RESUME manifest
+# ---------------------------------------------------------------------------
+
+
+def _manifest_path(directory: str) -> str:
+    return os.path.join(os.path.abspath(directory), RESUME_MANIFEST)
+
+
+def write_resume_manifest(directory: str, manifest: dict) -> str:
+    """Atomically (write-then-rename) persist the preemption manifest.
+    Coordinator-only on multi-host runs; every process may call."""
+    path = _manifest_path(directory)
+    if jax.process_index() != 0:
+        return path
+    os.makedirs(os.path.abspath(directory), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def read_resume_manifest(directory: str) -> Optional[dict]:
+    """The manifest left by a preempted run, or None (absent/corrupt —
+    a half-written manifest can only be pre-rename garbage, which this
+    never reads)."""
+    try:
+        with open(_manifest_path(directory)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+
+
+def clear_resume_manifest(directory: str) -> None:
+    """Remove the manifest (a run that COMPLETES must not leave a stale
+    mid-run cursor for the next resume to trust)."""
+    if jax.process_index() != 0:
+        return
+    try:
+        os.remove(_manifest_path(directory))
+    except OSError:
+        pass

@@ -76,6 +76,11 @@ class TrainTask:
     # data_size x num_microbatches: the compiled schedule reshapes each
     # data shard into M microbatches, so eval/val batches must divide
     batch_quantum: int = 0
+    # loader-item indices skipped by OOM fault tolerance, in order —
+    # recorded so a resumed run can prove the cursor accounting (the
+    # RESUME manifest carries them) and postmortems can name the lost
+    # batches by global index
+    skipped_items: list = dataclasses.field(default_factory=list)
     # the top-k metrics compiled into eval_fn; ``train`` reports these
     # by default so a mode that compiles loss-only eval (the LM
     # pipelines) needs no caller-side coordination
@@ -767,12 +772,71 @@ def restore_training(
     optimizer state + BatchNorm stats + step counter).
 
     Restores the latest (or given) step from ``checkpoint_dir`` onto the
-    task's mesh, replicated, ready for ``train``.
+    task's mesh, replicated, ready for ``train``.  For preemption-aware
+    resume (data-loader cursor + elastic device-count change) use
+    :func:`resume_training`.
     """
     from .checkpoint import load_checkpoint
 
     task.state = load_checkpoint(checkpoint_dir, task.state, step=step, mesh=task.mesh)
     return task
+
+
+def resume_training(
+    task: TrainTask, checkpoint_dir: str, step: Optional[int] = None
+) -> Optional[dict]:
+    """Preemption-aware resume: restore state AND the run cursor so a
+    resumed run is step-for-step identical to an uninterrupted one.
+
+    Reads the RESUME manifest a preempted ``train`` left next to its
+    checkpoint (step, data-loader cursor, skipped items, mesh
+    topology).  When the manifest's topology matches the task's, the
+    checkpoint restores sharded in place; on a device-count change
+    (the elastic case — the next grant gave a different slice) it
+    restores via host arrays and re-commits every leaf to the NEW
+    mesh's shardings, re-splitting ZeRO-1's padded flat optimizer
+    shards (:func:`..train.checkpoint.load_checkpoint_elastic`).
+
+    Returns the manifest (or ``None``: no manifest — plain
+    latest-checkpoint resume with the cursor derived from the step
+    counter; or nothing on disk at all — the task is left untouched,
+    a fresh run).
+    """
+    from .. import faults
+    from .checkpoint import (
+        latest_step, load_checkpoint, load_checkpoint_elastic,
+        read_resume_manifest,
+    )
+
+    faults.fire("resume")
+    manifest = read_resume_manifest(checkpoint_dir)
+    ckpt_step = (manifest or {}).get("checkpoint_step", step)
+    if ckpt_step is None:
+        ckpt_step = latest_step(checkpoint_dir)
+        if ckpt_step is None:
+            return None  # nothing saved yet: fresh run
+    mesh_now = {k: int(v) for k, v in dict(task.mesh.shape).items()}
+    same_topology = manifest is None or (
+        manifest.get("device_count") == jax.device_count()
+        and manifest.get("mesh") == mesh_now
+    )
+    if same_topology:
+        task.state = load_checkpoint(
+            checkpoint_dir, task.state, step=ckpt_step, mesh=task.mesh)
+    else:
+        task.state = load_checkpoint_elastic(
+            checkpoint_dir, task.state, step=ckpt_step)
+    spc = max(1, getattr(task, "steps_per_call", 1))
+    if manifest is not None:
+        task.loader.start = int(manifest.get("next_item", 0))
+        task.num_missed = int(manifest.get("num_missed", 0))
+        task.skipped_items = list(manifest.get("skipped_items", []))
+    else:
+        # no manifest (a cadence checkpoint from an old-style run):
+        # the step counter is the only cursor — correct when nothing
+        # was OOM-skipped before the checkpoint
+        task.loader.start = int(task.state.step) // spc
+    return manifest
 
 
 def _is_oom(err: Exception) -> bool:
@@ -988,6 +1052,7 @@ def train(
     profile_start: int = 10,
     profile_steps: int = 5,
     observation: Optional[Observation] = None,
+    handle_signals: bool = False,
 ):
     """The training loop (``train`` src/ddp_tasks.jl:174-247).
 
@@ -1013,9 +1078,29 @@ def train(
     ``block_until_ready``-syncs each step so device time is honestly
     attributed to a ``device`` phase.
 
+    ``handle_signals=True`` arms checkpoint-on-preemption
+    (:mod:`fluxdistributed_tpu.faults`): SIGTERM/SIGINT set a flag that
+    the loop checks at the next STEP BOUNDARY (state is always
+    consistent there — never mid-step, never with donated buffers in
+    flight), writes a blocking sharded checkpoint plus a ``RESUME.json``
+    manifest (step, data-loader cursor, skipped items, mesh topology)
+    into ``checkpoint_dir``, and raises :class:`~..faults.Preempted` —
+    ``bin/driver.py`` maps it to exit code 75 so a supervisor requeues
+    with ``--resume``.  A resumed run (:func:`resume_training`)
+    continues with step-for-step identical losses.  On multi-host runs
+    the flag is agreed via :func:`..parallel.multihost.agree_to_stop`
+    each step, so every host checkpoints at the same boundary.
+
+    Resume cursor: the loop starts at ``task.loader.start`` (0 for a
+    fresh run; :func:`resume_training` sets it from the manifest), and
+    the loader draws batches keyed by ABSOLUTE item index — parity
+    holds no matter where the run was cut.
+
     Returns ``(host_params, host_model_state, task)`` — the host-side
     model copy the reference returns from ``train`` (:241-246).
     """
+    from .. import faults as faults_lib
+    from ..parallel import multihost
     logger = logger or current_logger()
     obs = observation or Observation.default()
     phases = _PhaseClock(obs)
@@ -1045,7 +1130,6 @@ def train(
     # be monotonic (NTP steps or DST jumps would corrupt steps/sec and
     # the span timeline) — lint rule FDT102
     t_start = time.perf_counter()
-    t_mark, j_mark = t_start, 0
     profiling = False
     # device loop: each loader item is K stacked batches = K optimizer
     # steps in one dispatch; cadences below tick per ITEM (= per K steps)
@@ -1059,10 +1143,83 @@ def train(
 
     it = iter(task.loader)
     _end = object()
-    j = 0
+    start_item = int(getattr(task.loader, "start", 0))
+    j = start_item
+    t_mark, j_mark = t_start, start_item
     done_steps = 0  # optimizer steps that actually ran (skips excluded)
+    preempt = faults_lib.SignalFlag().install() if handle_signals else None
+    # eval and checkpoint are KNOWN-long in-loop work: suspend stall
+    # detection around them (a 2 s checkpoint snapshot in a 100 ms-step
+    # run must not flip /healthz to 503)
+    wd_pause = (obs.watchdog.pause if obs.watchdog is not None
+                else contextlib.nullcontext)
+
+    def _preempted() -> bool:
+        if preempt is None or not handle_signals:
+            return False
+        hit = preempt.is_set()
+        if jax.process_count() > 1:
+            # every host must agree on the boundary, or one host enters
+            # the collective checkpoint save the others never join
+            hit = multihost.agree_to_stop(hit)
+        return hit
+
+    def _checkpoint_and_exit() -> None:
+        """The checkpoint-on-signal exit: blocking sharded save + an
+        atomically-written RESUME manifest, then a distinct signal to
+        the caller (``Preempted`` → driver rc 75)."""
+        from .checkpoint import save_checkpoint, write_resume_manifest
+
+        step_now = int(task.state.step)
+        manifest = {
+            "version": 1,
+            "reason": preempt.reason if preempt is not None else "requested",
+            "checkpoint_step": step_now,
+            "next_item": j,
+            "steps_per_call": spc,
+            "num_missed": int(task.num_missed),
+            "skipped_items": [int(x) for x in task.skipped_items],
+            "mesh": {k: int(v) for k, v in dict(task.mesh.shape).items()},
+            "device_count": jax.device_count(),
+            "process_count": jax.process_count(),
+            # how the two rng streams re-derive on resume — both are
+            # keyed on restored values, so no rng state needs saving
+            "rng": {
+                "step": "fold_in(PRNGKey(seed), state.step), in-graph",
+                "loader": "np.random.default_rng((seed, process, item))",
+            },
+        }
+        if checkpoint_dir:
+            with wd_pause(), phases("checkpoint"):
+                # blocking: the process is about to exit — an async
+                # write would race the runtime teardown
+                save_checkpoint(task.state, checkpoint_dir, step_now,
+                                block=True)
+                write_resume_manifest(checkpoint_dir, manifest)
+            faults_lib.record_preemption()
+            logger.info(
+                f"preempted ({manifest['reason']}): checkpointed step "
+                f"{step_now} + RESUME manifest (next item {j}) in "
+                f"{checkpoint_dir}")
+        else:
+            logger.info(
+                f"preempted ({manifest['reason']}) with no "
+                "checkpoint_dir — nothing persisted, the run cannot "
+                "be resumed")
+        raise faults_lib.Preempted(
+            f"training preempted at step {step_now} (item {j})",
+            step=step_now, next_item=j, checkpoint_dir=checkpoint_dir,
+            manifest=manifest)
+
     try:
         while True:
+            # deterministic injection point for SIGTERM-at-step-k (the
+            # fault plan delivers the signal; the very next check sees
+            # it) — and THE step-boundary preemption check: state here
+            # is consistent, no donated buffers are in flight
+            faults_lib.fire("step", index=j)
+            if _preempted():
+                _checkpoint_and_exit()
             t_item = time.perf_counter()
             # data_wait: host time BLOCKED on the prefetch queue — nonzero
             # percentiles here mean the input pipeline, not the model, is
@@ -1145,16 +1302,20 @@ def train(
                             "re-run prepare_training(donate=False) for OOM-skip"
                         ) from e
                     task.num_missed += spc
+                    task.skipped_items.append(j)
                     oom_total.inc(spc)
+                    # the skipped batch's GLOBAL indices go on record:
+                    # the data cursor advances past it (j increments
+                    # below as for any item), so a resume after this
+                    # skip replays the exact same remaining stream —
+                    # and the log says which samples training never saw
+                    logger.log(
+                        {"oom_skipped_item": j,
+                         "oom_skipped_step_first": j * spc}, j)
                     logger.info(f"cycle {j}: device OOM — skipping batch ({task.num_missed} missed)")
                     skipped = True
                 else:
                     raise
-            # eval and checkpoint are KNOWN-long in-loop work: suspend
-            # stall detection around them (a 2 s checkpoint snapshot in
-            # a 100 ms-step run must not flip /healthz to 503)
-            wd_pause = (obs.watchdog.pause if obs.watchdog is not None
-                        else contextlib.nullcontext)
             if not skipped:
                 if eval_every and j % eval_every == 0:
                     with wd_pause(), phases("eval"):
@@ -1184,6 +1345,8 @@ def train(
                 obs.watchdog.beat()
             j += 1
     finally:
+        if preempt is not None:
+            preempt.uninstall()
         if obs.watchdog is not None:
             obs.watchdog.stop()
         if marked_steady:
@@ -1205,9 +1368,12 @@ def train(
     if task.num_missed:
         logger.info(f"missed {task.num_missed} batches due to OOM")
     if checkpoint_dir:
-        from .checkpoint import wait_for_pending
+        from .checkpoint import clear_resume_manifest, wait_for_pending
 
         wait_for_pending()
+        # a COMPLETED run must not leave a mid-run cursor behind: a
+        # later --resume would trust it and skip work
+        clear_resume_manifest(checkpoint_dir)
     host_params = tree_lib.to_host(task.state.params)
     host_mstate = tree_lib.to_host(task.state.model_state)
     return host_params, host_mstate, task

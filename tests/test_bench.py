@@ -8,6 +8,7 @@ always-emit-JSON contract get CI coverage on the fake mesh.
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 import pytest
@@ -143,6 +144,108 @@ def test_status_file_snapshots_phase_and_compile_ledger(bench_mod, tmp_path):
     for key in ("compile_seconds", "cache_hits", "cache_misses"):
         assert key in snap
     bench_mod._write_status(None, "ignored")  # disabled path: no raise
+
+
+def _tiny_build_step(batch, **kw):
+    """A stand-in for build_step so the resumable state machine is
+    testable in seconds: same (step, state, batch) contract, trivial
+    compile."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def step(state, b):
+        s = state + b["image"].sum()
+        return s, {"loss": s}
+
+    return step, jnp.zeros(()), {"image": np.ones((batch, 2), np.float32)}
+
+
+def test_resumable_warm_then_measure(bench_mod, tmp_path, monkeypatch, capsys):
+    """Attempt N warms (AOT serialized, ledger advances to 'warmed'),
+    attempt N+1 loads the executable and emits a real number with
+    attempts/interrupted_at provenance."""
+    monkeypatch.setattr(bench_mod, "build_step", _tiny_build_step)
+    monkeypatch.setattr(bench_mod, "step_flops", lambda *a: 0.0)
+    monkeypatch.setenv("FDTPU_COMPILE_CACHE_DIR", "")  # no cache dir churn
+    monkeypatch.setenv("FDTPU_AOT_DIR", str(tmp_path / "aot"))
+    ledger = str(tmp_path / "ledger.json")
+
+    # a huge measure margin forces the warm-only outcome (models a
+    # budget that only covers the cold half)
+    rc = bench_mod.resumable_main(
+        ["--ledger", ledger, "--budget", "300", "--steps", "2",
+         "--measure-margin", "1e9"])
+    assert rc == 0
+    warmed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert warmed["warmed"] is True and warmed["value"] == 0.0
+    assert warmed["resumable"]["state"] == "warmed"
+    assert warmed["resumable"]["attempts"] == 1
+    assert any(f.startswith("bench_step-")
+               for f in os.listdir(tmp_path / "aot"))
+
+    rc = bench_mod.resumable_main(
+        ["--ledger", ledger, "--budget", "300", "--steps", "2"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] > 0
+    assert out["aot_loaded"] is True, "attempt 2 must SKIP the compile"
+    assert out["measure_steps"] == 2
+    assert out["resumable"] == {
+        "attempts": 2, "interrupted_at": None, "state": "measured",
+        "ledger": ledger}
+
+
+def test_resumable_error_json_classifies_retryable(
+        bench_mod, tmp_path, monkeypatch, capsys):
+    """A code failure in the build phase emits retryable: false (the
+    watcher stops); a backend-unavailable failure emits retryable: true
+    (the watcher backs off and retries)."""
+    from fluxdistributed_tpu import faults
+
+    ledger = str(tmp_path / "ledger.json")
+
+    def broken(batch, **kw):
+        raise TypeError("injected code bug")
+
+    monkeypatch.setattr(bench_mod, "build_step", broken)
+    rc = bench_mod.resumable_main(["--ledger", ledger, "--budget", "60"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 0.0
+    assert out["phase"] == "build"
+    assert out["retryable"] is False
+    assert out["resumable"]["interrupted_at"] == "build"
+
+    # simulated backend-unavailable on init: the acquire_backend
+    # retries are exhausted by the plan, and the death is retryable
+    faults.install_plan(faults.FaultPlan().backend_unavailable(99))
+    try:
+        rc = bench_mod.resumable_main(
+            ["--ledger", str(tmp_path / "l2.json"), "--budget", "10"])
+    finally:
+        faults.clear_plan()
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["phase"] == "backend_init"
+    assert out["retryable"] is True
+
+
+def test_main_error_json_carries_retryable(bench_mod, monkeypatch, capsys):
+    """The classic bounded-subprocess path classifies its error JSON
+    too, so hw_watch.sh can gate its backoff on it."""
+    import subprocess
+
+    def boom(*a, **kw):
+        raise subprocess.TimeoutExpired(cmd="bench", timeout=1)
+
+    monkeypatch.setattr(subprocess, "run", boom)
+    monkeypatch.setattr(bench_mod.time, "sleep", lambda s: None)
+    bench_mod.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # a timeout with no phase marker died in backend territory
+    assert out["retryable"] is True
 
 
 def test_default_cache_dir_env_override(bench_mod, monkeypatch):

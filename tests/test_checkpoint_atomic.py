@@ -1,0 +1,260 @@
+"""Checkpoint atomicity: a death mid-write NEVER costs the previous
+checkpoint.
+
+Protocol under test (train/checkpoint.py): orbax streams into
+``step_<n>.tmp.<pid>`` and the staging dir is renamed into place only
+once fully written — so ``latest_step`` can only ever see complete
+checkpoints.  Fast tier simulates the two death points (mid-write,
+write-done-rename-pending) in-process; the slow tier does it for real
+with ``kill -9`` on a subprocess.  Parametrized over a dense host
+pytree and a ZeRO-1 sharded ``TrainState`` (flat data-sharded optimizer
+leaves), since orbax writes those through different codepaths.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from fluxdistributed_tpu import data_mesh, faults, optim
+from fluxdistributed_tpu.parallel import zero1
+from fluxdistributed_tpu.train import (
+    latest_step,
+    load_checkpoint,
+    save_checkpoint,
+    wait_for_pending,
+)
+import fluxdistributed_tpu.train.checkpoint as ck_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _dense_state(value=1.0):
+    return {"params": {"w": np.full((4, 3), value, np.float32),
+                       "b": np.full((10,), value, np.float32)},
+            "step": np.asarray(7, np.int32)}
+
+
+def _sharded_state(value=1.0):
+    import jax
+
+    params = {"w": np.full((4, 3), value, np.float32),
+              "b": np.full((10,), value, np.float32)}
+    state, _ = zero1.zero1_state(
+        jax.tree.map(lambda x: x, params), optim.adam(1e-3), data_mesh())
+    return state
+
+
+STATES = {"dense": _dense_state, "sharded": _sharded_state}
+
+
+@pytest.fixture(params=sorted(STATES))
+def make_state(request):
+    return STATES[request.param]
+
+
+def _leaves(tree):
+    import jax
+
+    return [np.asarray(x) for x in jax.tree.leaves(tree)]
+
+
+def test_interrupted_rename_leaves_previous_loadable(
+        tmp_path, make_state, monkeypatch):
+    """Death between write-finish and publish-rename: the staging dir is
+    complete but uncommitted — latest_step still answers step 1."""
+    save_checkpoint(make_state(1.0), str(tmp_path), 1)
+
+    def die(tmp, final):
+        raise RuntimeError("simulated kill between write and rename")
+
+    monkeypatch.setattr(ck_mod, "_commit_rename", die)
+    with pytest.raises(RuntimeError, match="simulated kill"):
+        save_checkpoint(make_state(2.0), str(tmp_path), 2)
+    monkeypatch.undo()
+
+    assert latest_step(str(tmp_path)) == 1
+    names = os.listdir(tmp_path)
+    assert not any(n == "step_2" for n in names)
+    assert any(".tmp." in n for n in names), "staging dir left (harmless)"
+    restored = load_checkpoint(str(tmp_path), make_state(1.0))
+    for got, want in zip(_leaves(restored), _leaves(make_state(1.0))):
+        np.testing.assert_allclose(got, want)
+    # the next save of the same step sweeps the stale staging dir and
+    # commits clean
+    save_checkpoint(make_state(3.0), str(tmp_path), 2)
+    assert latest_step(str(tmp_path)) == 2
+    assert not any(".tmp." in n for n in os.listdir(tmp_path))
+
+
+def test_interrupted_write_leaves_previous_loadable(
+        tmp_path, make_state, monkeypatch):
+    """Death MID-write: only partial staging garbage exists — never a
+    committed half-checkpoint."""
+    save_checkpoint(make_state(1.0), str(tmp_path), 1)
+
+    class DyingCkptr:
+        def save(self, path, state):
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, "partial"), "w") as f:
+                f.write("garbage")
+            raise RuntimeError("simulated kill mid-write")
+
+        def wait_until_finished(self):
+            pass
+
+    monkeypatch.setattr(ck_mod.ocp, "StandardCheckpointer", DyingCkptr)
+    with pytest.raises(RuntimeError, match="mid-write"):
+        save_checkpoint(make_state(2.0), str(tmp_path), 2)
+    monkeypatch.undo()
+
+    assert latest_step(str(tmp_path)) == 1
+    restored = load_checkpoint(str(tmp_path), make_state(1.0))
+    for got, want in zip(_leaves(restored), _leaves(make_state(1.0))):
+        np.testing.assert_allclose(got, want)
+
+
+def test_async_save_commits_at_wait(tmp_path, make_state):
+    """block=False publishes at wait_for_pending — after the drain the
+    step dir exists, is complete, and no staging dir remains."""
+    save_checkpoint(make_state(5.0), str(tmp_path), 3, block=False)
+    wait_for_pending()
+    assert latest_step(str(tmp_path)) == 3
+    assert not any(".tmp." in n for n in os.listdir(tmp_path))
+    restored = load_checkpoint(str(tmp_path), make_state(1.0))
+    for got, want in zip(_leaves(restored), _leaves(make_state(5.0))):
+        np.testing.assert_allclose(got, want)
+
+
+def test_overwrite_same_step_swaps_atomically(tmp_path, make_state):
+    save_checkpoint(make_state(1.0), str(tmp_path), 1)
+    save_checkpoint(make_state(9.0), str(tmp_path), 1)
+    assert sorted(os.listdir(tmp_path)) == ["step_1"]
+    restored = load_checkpoint(str(tmp_path), make_state(0.0))
+    for got, want in zip(_leaves(restored), _leaves(make_state(9.0))):
+        np.testing.assert_allclose(got, want)
+    with pytest.raises(FileExistsError):
+        save_checkpoint(make_state(2.0), str(tmp_path), 1, overwrite=False)
+
+
+def test_failed_async_commit_does_not_wedge_later_saves(tmp_path):
+    """A commit that REFUSES (overwrite=False on an existing step)
+    surfaces once at wait_for_pending and is then dropped — it must not
+    poison the pending list and wedge every later save."""
+    save_checkpoint(_dense_state(1.0), str(tmp_path), 1)
+    save_checkpoint(_dense_state(2.0), str(tmp_path), 1,
+                    overwrite=False, block=False)
+    with pytest.raises(FileExistsError):
+        wait_for_pending()
+    wait_for_pending()  # drained: no re-raise
+    save_checkpoint(_dense_state(3.0), str(tmp_path), 2)
+    assert latest_step(str(tmp_path)) == 2
+    # step 1 kept its original content (the refused save changed nothing)
+    restored = load_checkpoint(str(tmp_path), _dense_state(0.0), step=1)
+    np.testing.assert_allclose(_leaves(restored)[0],
+                               _leaves(_dense_state(1.0))[0])
+
+
+def test_checkpoint_save_retries_injected_transient(tmp_path):
+    """The checkpoint-I/O with_retries boundary: one injected OSError
+    costs a backoff, not the checkpoint."""
+    faults.install_plan(
+        faults.FaultPlan().fail(
+            "checkpoint_save", times=1,
+            exc=lambda: OSError("injected disk hiccup")))
+    try:
+        save_checkpoint(_dense_state(4.0), str(tmp_path), 1)
+    finally:
+        faults.clear_plan()
+    assert latest_step(str(tmp_path)) == 1
+    restored = load_checkpoint(str(tmp_path), _dense_state(0.0))
+    np.testing.assert_allclose(_leaves(restored)[0],
+                               _leaves(_dense_state(4.0))[0])
+
+
+def test_checkpoint_load_retries_injected_transient(tmp_path):
+    save_checkpoint(_dense_state(4.0), str(tmp_path), 1)
+    faults.install_plan(
+        faults.FaultPlan().fail(
+            "checkpoint_load", times=1,
+            exc=lambda: OSError("injected read hiccup")))
+    try:
+        restored = load_checkpoint(str(tmp_path), _dense_state(0.0))
+    finally:
+        faults.clear_plan()
+    np.testing.assert_allclose(_leaves(restored)[0],
+                               _leaves(_dense_state(4.0))[0])
+
+
+# ---------------------------------------------------------------------------
+# the real thing: kill -9 (slow tier)
+# ---------------------------------------------------------------------------
+
+_CHILD = r"""
+import os, sys, time
+import numpy as np
+from fluxdistributed_tpu.mesh import force_host_devices
+force_host_devices(8)
+import fluxdistributed_tpu.train.checkpoint as ck
+
+directory = sys.argv[1]
+mode = sys.argv[2]
+state1 = {"w": np.full((64, 64), 1.0, np.float32)}
+state2 = {"w": np.full((64, 64), 2.0, np.float32)}
+ck.save_checkpoint(state1, directory, 1)
+
+if mode == "rename":
+    orig = ck._commit_rename
+    def pending(tmp, final):
+        print("KILL_ME_NOW", flush=True)
+        time.sleep(120)
+        orig(tmp, final)
+    ck._commit_rename = pending
+else:
+    import orbax.checkpoint as ocp
+    class Partial:
+        def save(self, path, state):
+            os.makedirs(path, exist_ok=True)
+            open(os.path.join(path, "partial"), "w").write("junk")
+            print("KILL_ME_NOW", flush=True)
+            time.sleep(120)
+        def wait_until_finished(self):
+            pass
+    ck.ocp.StandardCheckpointer = Partial
+ck.save_checkpoint(state2, directory, 2)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["write", "rename"])
+def test_kill_9_mid_write_previous_checkpoint_survives(tmp_path, mode):
+    child = tmp_path / "child.py"
+    child.write_text(_CHILD)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.Popen(
+        [sys.executable, str(child), str(tmp_path / "ck"), mode],
+        stdout=subprocess.PIPE, text=True, env=env, cwd=REPO)
+    try:
+        deadline = time.monotonic() + 240
+        for line in p.stdout:
+            if "KILL_ME_NOW" in line:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError("child never reached the kill point")
+        os.kill(p.pid, signal.SIGKILL)
+        p.wait(timeout=30)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert p.returncode == -signal.SIGKILL
+    ck_dir = str(tmp_path / "ck")
+    assert latest_step(ck_dir) == 1, os.listdir(ck_dir)
+    restored = load_checkpoint(ck_dir, {"w": np.zeros((64, 64), np.float32)})
+    np.testing.assert_allclose(restored["w"], 1.0)

@@ -92,6 +92,90 @@ def test_oom_with_donated_state_raises():
         train(task, print_every=0, eval_every=0, logger=NullLogger())
 
 
+def test_oom_skip_advances_cursor_and_logs_global_index():
+    """The skipped-step path advances the data cursor and records the
+    skipped batch's global index — the bookkeeping resume-after-skip
+    parity depends on."""
+    logged = []
+
+    class Capture(NullLogger):
+        def log(self, metrics, step=None):
+            logged.append((dict(metrics), step))
+
+    task = _task(cycles=4)
+    _inject_oom_once(task)
+    train(task, print_every=0, eval_every=0, logger=Capture())
+    assert task.skipped_items == [0]
+    assert any(m.get("oom_skipped_item") == 0 for m, _ in logged)
+    # cursor advanced past the skip: 4 items consumed, 3 steps applied
+    assert int(task.state.step) == 3
+
+
+def _mlp_task(cycles=5):
+    """A cheap task for the resume-parity flow (three prepares; an MLP
+    compiles in a fraction of resnet18's time)."""
+    from fluxdistributed_tpu.data import SyntheticDataset as DS
+    from fluxdistributed_tpu.models import MLP
+
+    ds = DS(nsamples=64, nclasses=10, shape=(8, 8, 3))
+    return prepare_training(
+        MLP(features=(10, 10)), ds, optim.adam(1e-3),
+        batch_size=8, cycles=cycles, topk=())
+
+
+def test_oom_skip_then_preempt_resume_replays_deterministically(tmp_path):
+    """Resume after an OOM-skip: the manifest's cursor counts the
+    skipped item, so the resumed run replays the exact remaining
+    stream — losses match an uninterrupted run with the same skip."""
+    from fluxdistributed_tpu import faults
+    from fluxdistributed_tpu.train import read_resume_manifest, resume_training
+
+    def record(task):
+        losses = []
+        orig = task.step_fn
+
+        def wrapped(state, batch):
+            out = orig(state, batch)
+            losses.append(float(out[1]["loss"]))
+            return out
+
+        task.step_fn = wrapped
+        return losses
+
+    # baseline: item 0 OOM-skipped, run to completion
+    ta = _mlp_task(cycles=5)
+    _inject_oom_once(ta)
+    la = record(ta)
+    train(ta, print_every=0, eval_every=0, logger=NullLogger())
+    assert len(la) == 4  # items 1..4
+
+    # same skip, preempted at item 2, resumed
+    tb = _mlp_task(cycles=5)
+    _inject_oom_once(tb)
+    lb = record(tb)
+    faults.install_plan(faults.FaultPlan().sigterm_at_step(2))
+    try:
+        with pytest.raises(faults.Preempted):
+            train(tb, print_every=0, eval_every=0, logger=NullLogger(),
+                  checkpoint_dir=str(tmp_path), checkpoint_every=0,
+                  handle_signals=True)
+    finally:
+        faults.clear_plan()
+    m = read_resume_manifest(tmp_path)
+    assert m["next_item"] == 2          # cursor counts the skipped item
+    assert m["checkpoint_step"] == 1    # only item 1 actually stepped
+    assert m["num_missed"] == 1
+    assert m["skipped_items"] == [0]
+
+    tb2 = _mlp_task(cycles=5)
+    lb2 = record(tb2)
+    resume_training(tb2, str(tmp_path))
+    assert tb2.num_missed == 1 and tb2.skipped_items == [0]
+    train(tb2, print_every=0, eval_every=0, logger=NullLogger())
+    assert lb + lb2 == la
+    assert int(tb2.state.step) == 4
+
+
 def test_oom_multihost_raises(monkeypatch):
     from fluxdistributed_tpu.parallel import multihost
 

@@ -1,0 +1,162 @@
+"""Graceful drain of the LM server (fast tier, FakeEngine — no
+compiles).
+
+SIGTERM-shaped shutdown contract: admissions stop (Draining → HTTP
+503, distinct from 429 backpressure), in-flight requests finish within
+the drain timeout, ``/healthz`` reports 503 + ``draining: true`` for
+the whole window so a load balancer pulls the replica, and the process
+can then exit 0 — a rolling restart loses no tokens.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from fluxdistributed_tpu.serve import Draining, Request, Scheduler
+from fluxdistributed_tpu.serve.server import LMServer
+
+
+class FakeEngine:
+    """Pure-python engine: decode emits token 1 per live slot; a small
+    sleep per step gives the drain window measurable width."""
+
+    max_slots = 2
+
+    def __init__(self, step_delay=0.0):
+        self.step_delay = step_delay
+
+    def validate_request(self, prompt_len, max_new_tokens):
+        pass
+
+    def prefill(self, slot, prompt, temperature, key):
+        return 7, 8
+
+    def step_decode(self):
+        if self.step_delay:
+            time.sleep(self.step_delay)
+        return [1] * self.max_slots
+
+    def reset_slot(self, slot):
+        pass
+
+    def compile_stats(self):
+        return {"decode_compiles": 1, "prefill_compiles": 1,
+                "insert_compiles": 1}
+
+
+def test_drain_finishes_inflight_then_refuses_admissions():
+    sched = Scheduler(FakeEngine(step_delay=0.005), max_queue=8)
+    srv = LMServer(sched, vocab=256)
+    reqs = [Request(prompt=[1, 2], max_new_tokens=20) for _ in range(3)]
+    for r in reqs:
+        sched.submit(r)
+    srv.start_loop()
+    try:
+        drained = srv.drain(timeout=10.0)
+        assert drained is True
+        assert all(r.done.is_set() for r in reqs)
+        assert all(len(r.generated) == 20 for r in reqs), (
+            "drain must FINISH in-flight decodes, not abort them")
+        with pytest.raises(Draining):
+            sched.submit(Request(prompt=[3], max_new_tokens=2))
+        assert sched.registry.value("fdtpu_serve_draining") == 1
+    finally:
+        srv.close()
+
+
+def test_drain_timeout_cuts_short_and_reports_false():
+    sched = Scheduler(FakeEngine(step_delay=0.05), max_queue=8)
+    srv = LMServer(sched, vocab=256)
+    req = Request(prompt=[1], max_new_tokens=10_000)
+    sched.submit(req)
+    srv.start_loop()
+    try:
+        t0 = time.monotonic()
+        drained = srv.drain(timeout=0.3)
+        assert drained is False
+        assert time.monotonic() - t0 < 5.0
+        assert not req.done.is_set()  # client sees its own timeout
+    finally:
+        srv.close()
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_healthz_and_submit_report_503_while_draining():
+    sched = Scheduler(FakeEngine(step_delay=0.02), max_queue=8)
+    srv = LMServer(sched, vocab=256)
+    httpd = srv.serve("127.0.0.1", 0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        code, body = _get(f"{base}/healthz")
+        assert code == 200 and body["ok"] and not body["draining"]
+        # park one long request so the drain window is observable
+        sched.submit(Request(prompt=[1], max_new_tokens=200))
+        sched.begin_drain()
+        code, body = _get(f"{base}/healthz")
+        assert code == 503
+        assert body["draining"] is True and body["ok"] is False
+        code, body = _post(f"{base}/v1/generate",
+                           {"prompt_tokens": [1, 2], "max_tokens": 2})
+        assert code == 503, body
+        assert body.get("draining") is True
+        assert srv.drain(timeout=30.0) is True
+    finally:
+        httpd.shutdown()
+        srv.close()
+
+
+def test_sigterm_handler_drains_and_stops_http():
+    """The bin/serve.py wiring end-to-end in-process: SIGTERM → drain →
+    httpd.shutdown → serve_forever returns → exit 0 path."""
+    sched = Scheduler(FakeEngine(step_delay=0.01), max_queue=8)
+    srv = LMServer(sched, vocab=256)
+    httpd = srv.serve("127.0.0.1", 0)
+    req = Request(prompt=[1, 2], max_new_tokens=30)
+    sched.submit(req)
+    uninstall = srv.install_drain_handler(httpd=httpd, timeout=10.0)
+    served = threading.Event()
+
+    def run():
+        httpd.serve_forever()
+        served.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert served.wait(timeout=30), "SIGTERM must stop serve_forever"
+        assert req.done.is_set()
+        assert len(req.generated) == 30
+        assert sched.draining
+    finally:
+        uninstall()
+        srv.close()
