@@ -75,6 +75,10 @@ fits 3600 && timeout 3600 python benchmarks/ingest_e2e.py --steps 20 --s2d >> "$
 
 echo "[$(stamp)] 7/8 attention-core microbench (incl. windowed-flash row)" | tee -a "$OUT/session.log"
 fits 2700 && timeout 2700 python benchmarks/attention_bench.py --window 1024 >> "$OUT/attention.jsonl" 2>> "$OUT/session.log"
+# flash-DECODE kernels on hardware (first compiled-Pallas decode rows:
+# dense cursor-skip / windowed ring+sinks / paged page-table walk vs the
+# engine's XLA gather+mask path — CPU fallback rows in docs/benchmarks.md)
+fits 2700 && timeout 2700 python benchmarks/attention_bench.py --decode --max-len 4096 --live 512 >> "$OUT/attention.jsonl" 2>> "$OUT/session.log"
 
 # serving decode: continuous batching vs sequential generate at
 # C={1,4,16} (CPU rows recorded in docs/benchmarks.md; these are the

@@ -134,6 +134,18 @@ def build_parser():
                    help="hash + refcount completed prompt blocks so "
                         "shared system prompts prefill once "
                         "(needs --paged, plain attention)")
+    p.add_argument("--attention-impl", default="xla",
+                   choices=["xla", "pallas"],
+                   help="decode attention core: 'pallas' runs the "
+                        "flash-decode kernel suite (ops/pallas_decode) — "
+                        "cursor block-skip, native windowed-ring/paged "
+                        "walks — with an XLA fallback off TPU; 'xla' is "
+                        "the reference gather+mask path")
+    p.add_argument("--kv-dtype", default=None,
+                   choices=["int8", "fp8"],
+                   help="quantize KV-cache storage (per-row scales ride "
+                        "in the cache; dequant is fused into reads): "
+                        "int8 halves bf16 KV bytes, quarters f32")
     p.add_argument("--kv-heads", type=int, default=None,
                    help="match the trainer's --kv-heads (GQA)")
     p.add_argument("--window", type=int, default=None,
@@ -226,7 +238,9 @@ def make_lm_app(args):
                       kv_block_size=args.kv_block_size,
                       kv_blocks=args.kv_blocks,
                       prefill_chunk=args.prefill_chunk,
-                      prefix_cache=args.prefix_cache)
+                      prefix_cache=args.prefix_cache,
+                      attention_impl=args.attention_impl,
+                      kv_dtype=args.kv_dtype)
     if args.prewarm or args.aot_dir:
         print(f"engine ready in {time.perf_counter() - t0:.1f}s "
               f"(compile_stats={engine.compile_stats()})", file=sys.stderr)

@@ -236,17 +236,83 @@ def _build_serve_paged() -> List[StepVariant]:
     ]
 
 
+def _build_serve_paged_pallas() -> List[StepVariant]:
+    """The paged pool again, but decoding through the Pallas fast path
+    with a quantized (int8) KV cache — the kernel-suite configuration
+    (ops/pallas_decode.py).  Sweeping it proves the flash-decode branch
+    keeps the paged invariants the XLA branch established: donation
+    vectors consumable, page indirection pure DATA (stable retrace
+    digests — kernel dispatch cannot break AOT keys), axis hygiene."""
+    import jax
+
+    from ..serve import engine as engine_mod
+
+    model, _ = _lm_setup(depth=1, heads=2)
+    params = model.init(jax.random.PRNGKey(0),
+                        jax.numpy.zeros((1, 8), "int32"), train=False)["params"]
+    eng = engine_mod.LMEngine(model, params, max_slots=2, max_len=64,
+                              layout="paged", kv_block_size=8,
+                              prefill_chunk=16, attention_impl="pallas",
+                              kv_dtype="int8")
+    src = _src(engine_mod)
+    return [
+        StepVariant(name="serve_paged_pallas:step", fn=eng._step_jit,
+                    args=eng._example_args("step"),
+                    donate_argnums=(1, 2, 4), mesh=None, source=src,
+                    carry=lambda a, o: (a[0], o[0], o[1], a[3], o[2])),
+        StepVariant(name="serve_paged_pallas:chunk", fn=eng._chunk_jit,
+                    args=eng._example_args("chunk"),
+                    donate_argnums=(1,), mesh=None, source=src,
+                    carry=lambda a, o: (a[0], o[0]) + a[2:]),
+    ]
+
+
+def _build_zero1_fused() -> List[StepVariant]:
+    """The fused packed ZeRO-1 update (parallel/zero1_fused.py): one
+    reduce-scatter + one fused Adam kernel + one all-gather inside the
+    shard_map — checked for the same donation/axis/retrace invariants
+    as the composable zero1 step it accelerates."""
+    import jax
+
+    import jax.numpy as jnp
+
+    from .. import mesh as mesh_lib
+    from ..ops import logitcrossentropy
+    from ..parallel import zero1_fused as zf
+    from ..parallel.dp import flax_loss_fn
+    from ..sharding import shard_batch
+
+    mesh = mesh_lib.data_mesh(8)
+    model, _ = _image_setup()
+    x = jnp.zeros((16, 8, 8, 3), jnp.float32)
+    y = jnp.zeros((16, 4), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x[:2], train=True)["params"]
+    loss_fn = flax_loss_fn(model, logitcrossentropy, has_aux_state=False)
+    state, _ = zf.zero1_fused_state(params, mesh)
+    step = zf.make_train_step_zero1_fused(
+        loss_fn, mesh, state, lr=1e-3, donate=True)
+    batch = shard_batch({"image": x, "label": y}, mesh)
+    return [StepVariant(
+        name="zero1_fused", fn=step, args=(state, batch),
+        donate_argnums=(0,), mesh=mesh, source=_src(zf),
+        execute=True,
+        carry=lambda args, out: (out[0], args[1]))]
+
+
 #: name → builder; the six parallelism variants the acceptance gate
-#: names, plus the serve engine's program pools (dense and paged)
+#: names, plus the serve engine's program pools (dense and paged, the
+#: paged Pallas/int8 fast path) and the fused ZeRO-1 update
 VARIANT_BUILDERS: Dict[str, Callable[[], List[StepVariant]]] = {
     "dp": _build_dp,
     "zero1": _build_zero1,
+    "zero1_fused": _build_zero1_fused,
     "fsdp": _build_fsdp,
     "tp": _build_tp,
     "pp_1f1b": _build_pp_1f1b,
     "context": _build_context,
     "serve": _build_serve,
     "serve_paged": _build_serve_paged,
+    "serve_paged_pallas": _build_serve_paged_pallas,
 }
 
 

@@ -172,7 +172,16 @@ def abstract_signature(args: Sequence[Any], kwargs: Optional[dict] = None) -> st
     fingerprint may share a serialized executable; anything else must
     not.  fdtpu-lint's FDT204 retrace check builds on this digest: a
     program whose trace moves under a fixed signature would break these
-    on-disk keys on every restart (docs/analysis.md)."""
+    on-disk keys on every restart (docs/analysis.md).
+
+    Pallas interpret-mode note: the kernels resolve "interpreter or
+    compiled" at TRACE time from the backend
+    (``ops.pallas_attention.interpret_mode``) rather than taking an
+    ``interpret`` argument, so the flag can never appear in this digest
+    — CPU- and TPU-built executables are keyed apart by the PLATFORM
+    field of :func:`topology_fingerprint` instead, which is the
+    deliberate split (interpretation is a consequence of the platform,
+    not an independent key axis)."""
     import jax
 
     leaves, treedef = jax.tree.flatten((tuple(args), kwargs or {}))

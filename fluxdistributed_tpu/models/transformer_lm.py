@@ -79,6 +79,50 @@ def rope(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax.Array
     return out.reshape(x.shape).astype(x.dtype)
 
 
+#: KV-cache storage scenarios: full-precision, int8 (4x smaller than
+#: f32, 2x smaller than bf16), fp8 e4m3 (same bytes as int8, no rounding
+#: step — hardware-dependent, stubbed behind dtype availability)
+KV_QUANTS = ("none", "int8", "fp8")
+
+
+def _kv_store_dtype(kv_quant: str):
+    """The cache leaf dtype for a quant scenario (None = model dtype)."""
+    if kv_quant == "int8":
+        return jnp.int8
+    if kv_quant == "fp8":
+        dt = getattr(jnp, "float8_e4m3fn", None)
+        if dt is None:
+            raise ValueError(
+                "kv_quant='fp8' needs jnp.float8_e4m3fn, which this "
+                "jax/jaxlib build does not provide — use kv_quant='int8'")
+        return dt
+    return None
+
+
+def quantize_kv(x: jax.Array, kv_quant: str):
+    """Quantize new K/V rows for cache storage: per-row-per-head absmax
+    scaling over the head dim.  ``x`` [..., H, D] → ``(stored [..., H, D]
+    in the storage dtype, scale [..., H] f32)``.  The scale rides in the
+    cache next to its rows (dense: per slot row; paged: per pool block
+    row), so every read path — XLA dequant-after-gather or the decode
+    kernel's in-kernel dequant — sees the same numbers."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    if kv_quant == "int8":
+        scale = jnp.maximum(amax, 1e-12) / 127.0
+        q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    else:  # fp8 e4m3: max normal 448
+        scale = jnp.maximum(amax, 1e-12) / 448.0
+        q = xf / scale[..., None]
+    return q.astype(_kv_store_dtype(kv_quant)), scale
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """Invert :func:`quantize_kv` into the model's compute dtype."""
+    return (q.astype(jnp.float32) * scale.astype(jnp.float32)[..., None]
+            ).astype(dtype)
+
+
 def _norm_layer(kind: str, dtype, name: Optional[str] = None,
                 eps: float = 1e-6):
     """``layernorm`` (GPT-2 style, default) or ``rmsnorm`` (Llama
@@ -144,9 +188,37 @@ class CausalSelfAttention(nn.Module):
     # parks a freed slot safely.  0 = dense (the default layout).
     kv_block_size: int = 0
     kv_blocks: int = 0
+    # decode attention implementation: "xla" (mask/gather over the cache,
+    # the reference path) or "pallas" (ops/pallas_decode.py flash-decode
+    # kernel — single-token steps only; prefill chunks stay XLA).  The
+    # kernel consumes every cache layout natively (cursor block-skip,
+    # windowed ring + sinks via slot_pos, paged page-table walk) and
+    # falls back to an XLA rendering of the same block-walk schedule on
+    # non-TPU backends (interpreter mode covers CPU kernel tests).
+    attention_impl: str = "xla"
+    # KV-cache storage quantization: "none" | "int8" | "fp8" — stored
+    # values carry per-row-per-head scales in sibling cache leaves
+    # (cached_k_scale/cached_v_scale); every attention read (XLA gather
+    # or the decode kernel) dequantizes the SAME stored numbers, so all
+    # impls agree token-for-token at a given quant setting.
+    kv_quant: str = "none"
 
     @nn.compact
     def __call__(self, x):
+        if self.attention_impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown attention_impl {self.attention_impl!r} "
+                "(xla|pallas)")
+        if self.kv_quant not in KV_QUANTS:
+            raise ValueError(
+                f"unknown kv_quant {self.kv_quant!r} ({'|'.join(KV_QUANTS)})")
+        if self.kv_quant != "none":
+            if not self.decode:
+                raise ValueError(
+                    "kv_quant quantizes the decode KV cache; build the "
+                    "model with decode=True (the training forward has no "
+                    "cache to quantize)")
+            _kv_store_dtype(self.kv_quant)  # fp8 availability check
         if self.slot_decode and not self.decode:
             raise ValueError("slot_decode=True requires decode=True (it is "
                              "a mode OF the KV-cache path)")
@@ -234,14 +306,25 @@ class CausalSelfAttention(nn.Module):
             bs_kv = self.kv_block_size
             pages = -(-cache_len // bs_kv)
             r_pad = pages * bs_kv
+            quant = self.kv_quant != "none"
+            store_dt = _kv_store_dtype(self.kv_quant)
             cached_k = self.variable(
                 "cache", "cached_k", jnp.zeros,
-                (self.kv_blocks, bs_kv, hkv, head_dim), k.dtype,
+                (self.kv_blocks, bs_kv, hkv, head_dim), store_dt or k.dtype,
             )
             cached_v = self.variable(
                 "cache", "cached_v", jnp.zeros,
-                (self.kv_blocks, bs_kv, hkv, head_dim), v.dtype,
+                (self.kv_blocks, bs_kv, hkv, head_dim), store_dt or v.dtype,
             )
+            k_scale = v_scale = None
+            if quant:
+                # per-row-per-head scales, pool-shaped like their rows
+                k_scale = self.variable(
+                    "cache", "cached_k_scale", jnp.zeros,
+                    (self.kv_blocks, bs_kv, hkv), jnp.float32)
+                v_scale = self.variable(
+                    "cache", "cached_v_scale", jnp.zeros,
+                    (self.kv_blocks, bs_kv, hkv), jnp.float32)
             cache_index = self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((b,), jnp.int32))
             page_table = self.variable(
@@ -277,13 +360,46 @@ class CausalSelfAttention(nn.Module):
                 pt = page_table.value  # [B, pages]
                 rows = jnp.arange(b)[:, None]  # [B, 1]
                 live = slot_live.value[:, None] > 0  # [B, 1] write gate
+                # the flash-decode kernel serves single-token steps only
+                # (chunked prefill is matmul-dense and stays XLA)
+                use_kernel = self.attention_impl == "pallas" and t == 1
+                if quant:
+                    k_store, k_sc = quantize_kv(k, self.kv_quant)
+                    v_store, v_sc = quantize_kv(v, self.kv_quant)
+                else:
+                    k_store, v_store = k, v
 
-                def gather_view(pool):
+                def write(phys, off):
+                    cached_k.value = cached_k.value.at[phys, off].set(
+                        k_store, mode="drop")
+                    cached_v.value = cached_v.value.at[phys, off].set(
+                        v_store, mode="drop")
+                    if quant:
+                        k_scale.value = k_scale.value.at[phys, off].set(
+                            k_sc, mode="drop")
+                        v_scale.value = v_scale.value.at[phys, off].set(
+                            v_sc, mode="drop")
+
+                def gather_view(pool, scale):
                     # -1 ("unallocated") clamps to block 0 purely to
                     # keep the gather in bounds; every such row is
                     # mask-excluded below
                     g = pool[jnp.maximum(pt, 0)]
-                    return g.reshape(b, r_pad, hkv, head_dim)
+                    g = g.reshape(b, r_pad, hkv, head_dim)
+                    if scale is None:
+                        return g
+                    s = scale.value[jnp.maximum(pt, 0)].reshape(
+                        b, r_pad, hkv)
+                    return dequantize_kv(g, s, self.dtype)
+
+                def kernel_out(cursor, sp):
+                    from ..ops.pallas_decode import flash_decode_paged
+
+                    return flash_decode_paged(
+                        q, cached_k.value, cached_v.value, pt, cursor,
+                        slot_pos=sp, window=self.window, sinks=self.sinks,
+                        k_scale=k_scale.value if quant else None,
+                        v_scale=v_scale.value if quant else None)
 
                 if self.window is None:
                     # logical row == global position.  Write first,
@@ -296,30 +412,17 @@ class CausalSelfAttention(nn.Module):
                     phys = jnp.where(keep & (phys >= 0), phys,
                                      self.kv_blocks)
                     off = wpos % bs_kv
-                    cached_k.value = cached_k.value.at[phys, off].set(
-                        k, mode="drop")
-                    cached_v.value = cached_v.value.at[phys, off].set(
-                        v, mode="drop")
-                    attn_k = gather_view(cached_k.value)
-                    attn_v = gather_view(cached_v.value)
-                    allow = (jnp.arange(r_pad)[None, None, :]
-                             <= wpos[:, :, None])  # [B, T, keys]
+                    write(phys, off)
+                    if use_kernel:
+                        out = kernel_out(wpos[:, 0], None)
+                    else:
+                        attn_k = gather_view(cached_k.value, k_scale)
+                        attn_v = gather_view(cached_v.value, v_scale)
+                        allow = (jnp.arange(r_pad)[None, None, :]
+                                 <= wpos[:, :, None])  # [B, T, keys]
+                        out = dot_product_attention(
+                            q, attn_k, attn_v, mask=allow[:, None])
                 else:
-                    # read [pages ∥ this chunk] BEFORE the rolling write
-                    # — the dense ring's order, so a key this chunk
-                    # evicts stays attendable for its own earlier queries
-                    attn_k = jnp.concatenate(
-                        [gather_view(cached_k.value), k], axis=1)
-                    attn_v = jnp.concatenate(
-                        [gather_view(cached_v.value), v], axis=1)
-                    sp = jnp.concatenate(
-                        [slot_pos.value, wpos], axis=1)[:, None, :]
-                    qg = wpos[:, :, None]  # [B, T, 1]
-                    allow = (sp >= 0) & (sp <= qg)
-                    in_band = sp > qg - self.window
-                    if self.sinks:
-                        in_band |= sp < self.sinks
-                    allow &= in_band
                     # the logical ring spans ALL paged rows: rounding
                     # cache_len up to a block multiple only RETAINS
                     # more, and retained out-of-band keys are
@@ -337,16 +440,51 @@ class CausalSelfAttention(nn.Module):
                     phys = jnp.where(keep & (phys >= 0), phys,
                                      self.kv_blocks)
                     off = lrow % bs_kv
-                    cached_k.value = cached_k.value.at[phys, off].set(
-                        k, mode="drop")
-                    cached_v.value = cached_v.value.at[phys, off].set(
-                        v, mode="drop")
-                    slot_pos.value = slot_pos.value.at[
-                        rows, jnp.where(keep, lrow, r_pad)].set(
-                        wpos, mode="drop")
+                    if use_kernel:
+                        # write-then-attend: at t == 1 the only key the
+                        # rolling write can evict sits a full ring
+                        # behind the cursor — out of band by
+                        # construction (ring >= window) — so the
+                        # post-write ring + slot_pos hold exactly the
+                        # attendable set, no concat needed
+                        write(phys, off)
+                        slot_pos.value = slot_pos.value.at[
+                            rows, jnp.where(keep, lrow, r_pad)].set(
+                            wpos, mode="drop")
+                        out = kernel_out(idx, slot_pos.value)
+                    else:
+                        # read [pages ∥ this chunk] BEFORE the rolling
+                        # write — the dense ring's order, so a key this
+                        # chunk evicts stays attendable for its own
+                        # earlier queries.  Under quantization the
+                        # chunk's own keys are attended through their
+                        # STORED (dequantized) values so every impl and
+                        # the sequential reference see identical math.
+                        k_at = (dequantize_kv(k_store, k_sc, self.dtype)
+                                if quant else k)
+                        v_at = (dequantize_kv(v_store, v_sc, self.dtype)
+                                if quant else v)
+                        attn_k = jnp.concatenate(
+                            [gather_view(cached_k.value, k_scale), k_at],
+                            axis=1)
+                        attn_v = jnp.concatenate(
+                            [gather_view(cached_v.value, v_scale), v_at],
+                            axis=1)
+                        sp = jnp.concatenate(
+                            [slot_pos.value, wpos], axis=1)[:, None, :]
+                        qg = wpos[:, :, None]  # [B, T, 1]
+                        allow = (sp >= 0) & (sp <= qg)
+                        in_band = sp > qg - self.window
+                        if self.sinks:
+                            in_band |= sp < self.sinks
+                        allow &= in_band
+                        write(phys, off)
+                        slot_pos.value = slot_pos.value.at[
+                            rows, jnp.where(keep, lrow, r_pad)].set(
+                            wpos, mode="drop")
+                        out = dot_product_attention(
+                            q, attn_k, attn_v, mask=allow[:, None])
                 cache_index.value = idx + t
-                out = dot_product_attention(
-                    q, attn_k, attn_v, mask=allow[:, None])
                 return nn.DenseGeneral(
                     d, axis=(-2, -1), dtype=self.dtype, name="out"
                 )(out)
@@ -364,14 +502,24 @@ class CausalSelfAttention(nn.Module):
                 t if self.window is None
                 else min(self.window + self.sinks + self.ring_slack, t)
             )
+            quant = self.kv_quant != "none"
+            store_dt = _kv_store_dtype(self.kv_quant)
             cached_k = self.variable(
                 "cache", "cached_k", jnp.zeros,
-                (b, cache_len, hkv, head_dim), k.dtype,
+                (b, cache_len, hkv, head_dim), store_dt or k.dtype,
             )
             cached_v = self.variable(
                 "cache", "cached_v", jnp.zeros,
-                (b, cache_len, hkv, head_dim), v.dtype,
+                (b, cache_len, hkv, head_dim), store_dt or v.dtype,
             )
+            k_scale = v_scale = None
+            if quant:
+                k_scale = self.variable(
+                    "cache", "cached_k_scale", jnp.zeros,
+                    (b, cache_len, hkv), jnp.float32)
+                v_scale = self.variable(
+                    "cache", "cached_v_scale", jnp.zeros,
+                    (b, cache_len, hkv), jnp.float32)
             # slot mode: one cursor (and one ring position table) PER
             # batch row, so every slot advances independently
             idx_shape = (b,) if self.slot_decode else ()
@@ -403,22 +551,88 @@ class CausalSelfAttention(nn.Module):
                     pos = idx[:, None]  # [B, 1] global positions
                     q, k = rope(q, pos), rope(k, pos)
                 rows = jnp.arange(b)
+                use_kernel = self.attention_impl == "pallas"
+                if quant:
+                    k_store, k_sc = quantize_kv(k, self.kv_quant)
+                    v_store, v_sc = quantize_kv(v, self.kv_quant)
+                else:
+                    k_store, v_store = k, v
+
+                def write(slot_idx, mode=None):
+                    kw = dict(mode=mode) if mode else {}
+                    cached_k.value = cached_k.value.at[rows, slot_idx].set(
+                        k_store[:, 0], **kw)
+                    cached_v.value = cached_v.value.at[rows, slot_idx].set(
+                        v_store[:, 0], **kw)
+                    if quant:
+                        k_scale.value = k_scale.value.at[rows, slot_idx].set(
+                            k_sc[:, 0], **kw)
+                        v_scale.value = v_scale.value.at[rows, slot_idx].set(
+                            v_sc[:, 0], **kw)
+
+                def kernel_out(sp):
+                    from ..ops.pallas_decode import flash_decode
+
+                    return flash_decode(
+                        q, cached_k.value, cached_v.value, idx,
+                        slot_pos=sp, window=self.window, sinks=self.sinks,
+                        k_scale=k_scale.value if quant else None,
+                        v_scale=v_scale.value if quant else None)
+
                 if self.window is None:
                     # parked slots may have run past the cache end; their
                     # writes drop harmlessly (output is discarded and the
                     # engine resets the cursor on re-admission)
-                    cached_k.value = cached_k.value.at[rows, idx].set(
-                        k[:, 0], mode="drop")
-                    cached_v.value = cached_v.value.at[rows, idx].set(
-                        v[:, 0], mode="drop")
+                    write(idx, mode="drop")
+                    if use_kernel:
+                        out = kernel_out(None)
+                        cache_index.value = idx + 1
+                        return nn.DenseGeneral(
+                            d, axis=(-2, -1), dtype=self.dtype, name="out"
+                        )(out)
                     allow = jnp.arange(total)[None, :] <= idx[:, None]
-                    attn_k, attn_v = cached_k.value, cached_v.value
+                    attn_k = (dequantize_kv(
+                        cached_k.value, k_scale.value, self.dtype)
+                        if quant else cached_k.value)
+                    attn_v = (dequantize_kv(
+                        cached_v.value, v_scale.value, self.dtype)
+                        if quant else cached_v.value)
                 else:
+                    ring = max(total - self.sinks, 1)
+                    if self.sinks:
+                        ring_slot = self.sinks + (idx - self.sinks) % ring
+                        slot = jnp.where(idx < self.sinks, idx, ring_slot)
+                    else:
+                        slot = idx % ring
+                    if use_kernel:
+                        # write-then-attend (see the paged branch: the
+                        # evicted key is a full ring behind the cursor,
+                        # out of band by construction)
+                        write(slot)
+                        slot_pos.value = slot_pos.value.at[rows, slot].set(
+                            idx)
+                        out = kernel_out(slot_pos.value)
+                        cache_index.value = idx + 1
+                        return nn.DenseGeneral(
+                            d, axis=(-2, -1), dtype=self.dtype, name="out"
+                        )(out)
                     # read [ring ∥ new token] BEFORE the rolling write —
                     # the same order as the scalar path, so the key this
                     # token evicts stays attendable for this very step
-                    attn_k = jnp.concatenate([cached_k.value, k], axis=1)
-                    attn_v = jnp.concatenate([cached_v.value, v], axis=1)
+                    # (quantized: attend the stored numbers, like every
+                    # other read path)
+                    ring_k = (dequantize_kv(
+                        cached_k.value, k_scale.value, self.dtype)
+                        if quant else cached_k.value)
+                    ring_v = (dequantize_kv(
+                        cached_v.value, v_scale.value, self.dtype)
+                        if quant else cached_v.value)
+                    k_at = (dequantize_kv(k_store, k_sc, self.dtype)
+                            if quant else k)
+                    v_at = (dequantize_kv(v_store, v_sc, self.dtype)
+                            if quant else v)
+                    attn_k = jnp.concatenate([ring_k, k_at], axis=1)
+                    attn_v = jnp.concatenate([ring_v, v_at], axis=1)
                     sp = jnp.concatenate(
                         [slot_pos.value, idx[:, None]], axis=1)  # [B, total+1]
                     qg = idx[:, None]
@@ -427,14 +641,7 @@ class CausalSelfAttention(nn.Module):
                     if self.sinks:
                         in_band |= sp < self.sinks
                     allow &= in_band
-                    ring = max(total - self.sinks, 1)
-                    if self.sinks:
-                        ring_slot = self.sinks + (idx - self.sinks) % ring
-                        slot = jnp.where(idx < self.sinks, idx, ring_slot)
-                    else:
-                        slot = idx % ring
-                    cached_k.value = cached_k.value.at[rows, slot].set(k[:, 0])
-                    cached_v.value = cached_v.value.at[rows, slot].set(v[:, 0])
+                    write(slot)
                     slot_pos.value = slot_pos.value.at[rows, slot].set(idx)
                 cache_index.value = idx + 1
                 allow = allow[:, None, None, :]  # [B, 1, 1, keys]
@@ -452,16 +659,54 @@ class CausalSelfAttention(nn.Module):
                     pos = idx + jnp.arange(t)  # global positions
                     q, k = rope(q, pos), rope(k, pos)
                 q_glob = (idx + jnp.arange(t))[:, None]
+                use_kernel = self.attention_impl == "pallas" and t == 1
+                if quant:
+                    k_store, k_sc = quantize_kv(k, self.kv_quant)
+                    v_store, v_sc = quantize_kv(v, self.kv_quant)
+                else:
+                    k_store, v_store = k, v
+
+                def kernel_out(sp):
+                    from ..ops.pallas_decode import flash_decode
+
+                    # scalar mode: one shared cursor (and ring position
+                    # table) for every batch row — broadcast both into
+                    # the kernel's per-slot layout
+                    return flash_decode(
+                        q, cached_k.value, cached_v.value,
+                        jnp.broadcast_to(idx, (b,)).astype(jnp.int32),
+                        slot_pos=(None if sp is None else jnp.broadcast_to(
+                            sp[None], (b, total))),
+                        window=self.window, sinks=self.sinks,
+                        k_scale=k_scale.value if quant else None,
+                        v_scale=v_scale.value if quant else None)
+
                 if self.window is None:
                     cached_k.value = jax.lax.dynamic_update_slice(
-                        cached_k.value, k, (0, idx, 0, 0)
+                        cached_k.value, k_store, (0, idx, 0, 0)
                     )
                     cached_v.value = jax.lax.dynamic_update_slice(
-                        cached_v.value, v, (0, idx, 0, 0)
+                        cached_v.value, v_store, (0, idx, 0, 0)
                     )
+                    if quant:
+                        k_scale.value = jax.lax.dynamic_update_slice(
+                            k_scale.value, k_sc, (0, idx, 0))
+                        v_scale.value = jax.lax.dynamic_update_slice(
+                            v_scale.value, v_sc, (0, idx, 0))
+                    if use_kernel:
+                        out = kernel_out(None)
+                        cache_index.value = idx + t
+                        return nn.DenseGeneral(
+                            d, axis=(-2, -1), dtype=self.dtype, name="out"
+                        )(out)
                     # query i (global position idx+i) attends keys [0, idx+i]
                     allow = jnp.arange(total)[None, :] <= q_glob
-                    attn_k, attn_v = cached_k.value, cached_v.value
+                    attn_k = (dequantize_kv(
+                        cached_k.value, k_scale.value, self.dtype)
+                        if quant else cached_k.value)
+                    attn_v = (dequantize_kv(
+                        cached_v.value, v_scale.value, self.dtype)
+                        if quant else cached_v.value)
                 else:
                     # `total` is the ring length (the STORED cache's
                     # shape — cache_len above is only meaningful at init,
@@ -473,14 +718,6 @@ class CausalSelfAttention(nn.Module):
                     # are disjoint (ring < idx ≤ chunk); -1 marks
                     # unwritten slots, never attendable.
                     wpos = idx + jnp.arange(t)
-                    attn_k = jnp.concatenate([cached_k.value, k], axis=1)
-                    attn_v = jnp.concatenate([cached_v.value, v], axis=1)
-                    sp = jnp.concatenate([slot_pos.value, wpos])[None, :]
-                    allow = (sp >= 0) & (sp <= q_glob)
-                    in_band = sp > q_glob - self.window
-                    if self.sinks:
-                        in_band |= sp < self.sinks
-                    allow &= in_band
                     # write layout: position p lives at slot p while
                     # p < sinks (pinned, never evicted), else at
                     # sinks + (p - sinks) % ring.  Only sink positions
@@ -497,12 +734,48 @@ class CausalSelfAttention(nn.Module):
                     else:
                         slot = wpos % ring
                     slots = jnp.where(keep, slot, total)  # total = dropped
-                    cached_k.value = cached_k.value.at[:, slots].set(
-                        k, mode="drop")
-                    cached_v.value = cached_v.value.at[:, slots].set(
-                        v, mode="drop")
-                    slot_pos.value = slot_pos.value.at[slots].set(
-                        wpos, mode="drop")
+
+                    def write():
+                        cached_k.value = cached_k.value.at[:, slots].set(
+                            k_store, mode="drop")
+                        cached_v.value = cached_v.value.at[:, slots].set(
+                            v_store, mode="drop")
+                        if quant:
+                            k_scale.value = k_scale.value.at[:, slots].set(
+                                k_sc, mode="drop")
+                            v_scale.value = v_scale.value.at[:, slots].set(
+                                v_sc, mode="drop")
+                        slot_pos.value = slot_pos.value.at[slots].set(
+                            wpos, mode="drop")
+
+                    if use_kernel:
+                        # write-then-attend: at t == 1 the evicted key is
+                        # a full ring behind the cursor — out of band
+                        write()
+                        out = kernel_out(slot_pos.value)
+                        cache_index.value = idx + t
+                        return nn.DenseGeneral(
+                            d, axis=(-2, -1), dtype=self.dtype, name="out"
+                        )(out)
+                    k_at = (dequantize_kv(k_store, k_sc, self.dtype)
+                            if quant else k)
+                    v_at = (dequantize_kv(v_store, v_sc, self.dtype)
+                            if quant else v)
+                    ring_k = (dequantize_kv(
+                        cached_k.value, k_scale.value, self.dtype)
+                        if quant else cached_k.value)
+                    ring_v = (dequantize_kv(
+                        cached_v.value, v_scale.value, self.dtype)
+                        if quant else cached_v.value)
+                    attn_k = jnp.concatenate([ring_k, k_at], axis=1)
+                    attn_v = jnp.concatenate([ring_v, v_at], axis=1)
+                    sp = jnp.concatenate([slot_pos.value, wpos])[None, :]
+                    allow = (sp >= 0) & (sp <= q_glob)
+                    in_band = sp > q_glob - self.window
+                    if self.sinks:
+                        in_band |= sp < self.sinks
+                    allow &= in_band
+                    write()
                 cache_index.value = idx + t
                 allow = allow[None, None]  # [1, 1, t, keys]
                 out = dot_product_attention(q, attn_k, attn_v, mask=allow)
@@ -545,6 +818,8 @@ class DecoderBlock(nn.Module):
     ring_slack: int = 0
     kv_block_size: int = 0
     kv_blocks: int = 0
+    attention_impl: str = "xla"  # decode core: xla | pallas flash-decode
+    kv_quant: str = "none"  # KV-cache storage: none | int8 | fp8
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -557,7 +832,8 @@ class DecoderBlock(nn.Module):
             num_kv_heads=self.num_kv_heads, window=self.window,
             sinks=self.sinks, slot_decode=self.slot_decode,
             ring_slack=self.ring_slack, kv_block_size=self.kv_block_size,
-            kv_blocks=self.kv_blocks,
+            kv_blocks=self.kv_blocks, attention_impl=self.attention_impl,
+            kv_quant=self.kv_quant,
         )(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -615,6 +891,8 @@ class MoEDecoderBlock(nn.Module):
     ring_slack: int = 0
     kv_block_size: int = 0
     kv_blocks: int = 0
+    attention_impl: str = "xla"  # decode core: xla | pallas flash-decode
+    kv_quant: str = "none"  # KV-cache storage: none | int8 | fp8
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -625,7 +903,8 @@ class MoEDecoderBlock(nn.Module):
             num_kv_heads=self.num_kv_heads, window=self.window,
             sinks=self.sinks, slot_decode=self.slot_decode,
             ring_slack=self.ring_slack, kv_block_size=self.kv_block_size,
-            kv_blocks=self.kv_blocks,
+            kv_blocks=self.kv_blocks, attention_impl=self.attention_impl,
+            kv_quant=self.kv_quant,
         )(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -687,6 +966,8 @@ class TransformerLM(nn.Module):
     # CausalSelfAttention.kv_block_size).  0/0 = dense layout.
     kv_block_size: int = 0
     kv_blocks: int = 0
+    attention_impl: str = "xla"  # decode core: xla | pallas flash-decode
+    kv_quant: str = "none"  # KV-cache storage: none | int8 | fp8
     num_kv_heads: Optional[int] = None  # GQA: grouped KV heads
     window: Optional[int] = None  # sliding-window attention
     sinks: int = 0  # StreamingLLM attention sinks (with window)
@@ -802,6 +1083,8 @@ class TransformerLM(nn.Module):
                     norm_eps=self.norm_eps, name=f"block{i}",
                     slot_decode=self.slot_decode, ring_slack=self.ring_slack,
                     kv_block_size=self.kv_block_size, kv_blocks=self.kv_blocks,
+                    attention_impl=self.attention_impl,
+                    kv_quant=self.kv_quant,
                 )(x, train)
             else:
                 x = block_cls(
@@ -813,6 +1096,8 @@ class TransformerLM(nn.Module):
                     norm_eps=self.norm_eps, name=f"block{i}",
                     slot_decode=self.slot_decode, ring_slack=self.ring_slack,
                     kv_block_size=self.kv_block_size, kv_blocks=self.kv_blocks,
+                    attention_impl=self.attention_impl,
+                    kv_quant=self.kv_quant,
                 )(x, train)
         x = _norm_layer(self.norm, self.dtype, name="final_ln", eps=self.norm_eps)(x)
         if self.tie_embeddings:

@@ -12,4 +12,26 @@ __all__ = [
     "dot_product_attention",
     "blockwise_attention",
     "attention_core",
+    # Pallas kernels (lazy: importing the package must not pay for
+    # jax.experimental.pallas unless a kernel is actually used)
+    "flash_attention",
+    "flash_attention_lse",
+    "flash_decode",
+    "flash_decode_paged",
 ]
+
+_LAZY = {
+    "flash_attention": "pallas_attention",
+    "flash_attention_lse": "pallas_attention",
+    "flash_decode": "pallas_decode",
+    "flash_decode_paged": "pallas_decode",
+}
+
+
+def __getattr__(name):  # PEP 562
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

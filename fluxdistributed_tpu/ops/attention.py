@@ -65,8 +65,23 @@ def attention_core(kind: str, block: int = 128, window: Optional[int] = None,
     if kind == "flash":
         from .pallas_attention import flash_attention
 
-        return partial(flash_attention, causal=True, block_q=block,
-                       block_k=block, window=window, sinks=sinks)
+        def _flash(q, k, v):
+            # the training kernel amortizes its [block_q, block_k] tiles
+            # over many query rows; a single-query (decode-shaped) call
+            # would silently run it at its worst shape — the decode
+            # kernels exist for exactly that workload
+            if q.shape[1] == 1 and k.shape[1] > 1:
+                raise ValueError(
+                    "attention_core(kind='flash') is the training/prefill "
+                    "kernel; single-query decode-shaped inputs (Tq=1 vs a "
+                    f"Tk={k.shape[1]} cache) belong to the flash-decode "
+                    "kernels (ops.pallas_decode.flash_decode[_paged]) — "
+                    "the serve engine wires them via attention_impl="
+                    "'pallas'")
+            return flash_attention(q, k, v, True, block, block,
+                                   window, sinks)
+
+        return _flash
     raise ValueError(f"unknown attention core {kind!r}")
 
 

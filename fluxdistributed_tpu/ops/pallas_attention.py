@@ -46,10 +46,33 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .attention import NEG_INF, online_softmax_update
 
-__all__ = ["flash_attention", "flash_attention_lse"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_lse",
+    "interpret_mode",
+]
 
 # m/l scratch rows are replicated across the VPU lane width.
 _LANES = 128
+
+
+def interpret_mode() -> bool:
+    """Whether Pallas kernels in this process run under the interpreter.
+
+    A pure function of the backend — a per-process constant — resolved
+    at TRACE time inside the jitted kernel wrappers, so the flag is NOT
+    an argument of any compiled program: it never enters a jit cache
+    key or an AOT argument-signature digest
+    (``compilation.abstract_signature``), and toggling backends cannot
+    retrace anything (there is nothing to toggle within a process).
+    CPU-built and TPU-built executables are still keyed apart, by the
+    *platform* field of ``compilation.topology_fingerprint`` — the
+    correct split: interpretation is a consequence of the platform, not
+    an independent axis.  (To run a specific kernel interpreted on TPU,
+    use the decode kernels' explicit ``impl="interpret"`` argument or
+    ``pltpu.force_tpu_interpret_mode()``.)
+    """
+    return jax.default_backend() != "tpu"
 # LSE pad value for rows beyond Tq: exp(s - 1e30) == 0, so padded query
 # rows contribute exactly nothing to dK/dV (and can never produce inf*0
 # NaNs the way a garbage LSE could).
@@ -336,11 +359,13 @@ def _gqa_dims(q, k):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "block_q", "block_k", "interpret", "window",
-                     "sinks"),
+    static_argnames=("causal", "block_q", "block_k", "window", "sinks"),
 )
-def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+def _flash_fwd_impl(q, k, v, causal, block_q, block_k,
                     window=None, sinks=0):
+    # trace-time constant (per-process) — deliberately NOT an argument,
+    # so it cannot enter jit/AOT signature digests (see interpret_mode)
+    interpret = interpret_mode()
     b, tq, h, d = q.shape
     tk = k.shape[1]
     h, hkv, group = _gqa_dims(q, k)
@@ -390,11 +415,11 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "block_q", "block_k", "interpret", "window",
-                     "sinks"),
+    static_argnames=("causal", "block_q", "block_k", "window", "sinks"),
 )
-def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
+def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k,
                     g_lse=None, window=None, sinks=0):
+    interpret = interpret_mode()
     b, tq, h, d = q.shape
     tk = k.shape[1]
     h, hkv, group = _gqa_dims(q, k)
@@ -512,8 +537,7 @@ def flash_attention(
     blocks stay live while everything between sink and band is skipped.
     """
     _validate_window(causal, window, sinks)
-    interpret = jax.default_backend() != "tpu"
-    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k,
                              window=window, sinks=sinks)
     return out
 
@@ -537,17 +561,15 @@ def _fwd(q, k, v, causal, block_q, block_k, window, sinks):
     # custom_vjp skips the primal body under jax.grad — re-validate here
     # or invalid combos would silently trace through in training steps
     _validate_window(causal, window, sinks)
-    interpret = jax.default_backend() != "tpu"
-    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k,
                                window=window, sinks=sinks)
     return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, block_q, block_k, window, sinks, res, g):
     q, k, v, o, lse = res
-    interpret = jax.default_backend() != "tpu"
     return _flash_bwd_impl(
-        q, k, v, o, lse, g, causal, block_q, block_k, interpret,
+        q, k, v, o, lse, g, causal, block_q, block_k,
         window=window, sinks=sinks,
     )
 
@@ -580,16 +602,14 @@ def flash_attention_lse(
                          "attention is a causal-LM construct)")
     if window is not None and window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
-    interpret = jax.default_backend() != "tpu"
-    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k,
                                window=window)
     b, tq, h, _ = q.shape
     return out, lse.reshape(b, h, tq)
 
 
 def _fwd_lse(q, k, v, causal, block_q, block_k, window):
-    interpret = jax.default_backend() != "tpu"
-    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k,
                                window=window)
     b, tq, h, _ = q.shape
     return (out, lse.reshape(b, h, tq)), (q, k, v, out, lse)
@@ -599,9 +619,8 @@ def _bwd_lse(causal, block_q, block_k, window, res, g):
     q, k, v, o, lse = res
     g_out, g_lse = g
     b, tq, h, _ = q.shape
-    interpret = jax.default_backend() != "tpu"
     return _flash_bwd_impl(
-        q, k, v, o, lse, g_out, causal, block_q, block_k, interpret,
+        q, k, v, o, lse, g_out, causal, block_q, block_k,
         g_lse=g_lse.reshape(b * h, tq), window=window,
     )
 

@@ -10,6 +10,12 @@ from .dp import TrainState, make_train_step, make_eval_step, make_train_step_sha
 from . import fsdp
 from .fsdp import fsdp_specs, hybrid_fsdp_tp_specs, make_train_step_fsdp, make_eval_step_fsdp
 from . import zero1
+from . import zero1_fused
+from .zero1_fused import (
+    fused_adam_update,
+    make_train_step_zero1_fused,
+    zero1_fused_state,
+)
 from .zero1 import (
     make_train_step_zero1,
     make_train_step_zero1_shardmap,
@@ -44,6 +50,10 @@ __all__ = [
     "make_train_step_fsdp",
     "make_eval_step_fsdp",
     "zero1",
+    "zero1_fused",
+    "fused_adam_update",
+    "make_train_step_zero1_fused",
+    "zero1_fused_state",
     "make_train_step_zero1",
     "make_train_step_zero1_shardmap",
     "zero1_optimizer",

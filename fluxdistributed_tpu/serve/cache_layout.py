@@ -33,7 +33,8 @@ import hashlib
 from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["DenseLayout", "PagedLayout", "BlockPool", "prefix_digests"]
+__all__ = ["DenseLayout", "PagedLayout", "BlockPool", "prefix_digests",
+           "KV_STORE_BYTES", "kv_row_bytes"]
 
 
 def prefix_digests(tokens: Sequence[int], block_size: int) -> List[bytes]:
@@ -55,20 +56,51 @@ def prefix_digests(tokens: Sequence[int], block_size: int) -> List[bytes]:
     return out
 
 
+#: bytes per stored K or V element under each quant scenario (None =
+#: the model's compute itemsize); quantized scenarios additionally
+#: carry a per-row-per-head f32 scale (``models.transformer_lm
+#: .quantize_kv``)
+KV_STORE_BYTES = {"none": None, "int8": 1, "fp8": 1}
+
+
+def kv_row_bytes(hkv: int, head_dim: int, kv_quant: str,
+                 compute_itemsize: int) -> int:
+    """HBM bytes one cache row (K + V, all KV heads) costs per layer:
+    stored values plus the sibling scale rows for quantized scenarios —
+    the sizing model behind the engine's measured ``kv_cache_bytes``
+    (the bytes-halved test pins the two against each other)."""
+    if kv_quant not in KV_STORE_BYTES:
+        raise ValueError(
+            f"unknown kv_quant {kv_quant!r} ({'|'.join(KV_STORE_BYTES)})")
+    item = KV_STORE_BYTES[kv_quant] or compute_itemsize
+    per = hkv * head_dim * item
+    if KV_STORE_BYTES[kv_quant]:
+        per += hkv * 4  # f32 scale per row per head
+    return 2 * per  # K and V
+
+
 class DenseLayout:
     """The original fixed-slot layout: each slot statically owns
     ``rows_per_slot`` contiguous KV rows per layer.  Admission never
     waits on memory — capacity IS ``max_slots`` — so the allocator
-    surface is trivially permissive."""
+    surface is trivially permissive.  ``kv_quant`` records the storage
+    scenario riding in the device cache (scale leaves live NEXT TO their
+    K/V rows, same indexing) so stats and sizing math stay layout-aware.
+    """
 
     name = "dense"
 
-    def __init__(self, max_slots: int, rows_per_slot: int):
+    def __init__(self, max_slots: int, rows_per_slot: int,
+                 kv_quant: str = "none"):
         self.max_slots = max_slots
         self.rows_per_slot = rows_per_slot
+        self.kv_quant = kv_quant
 
     def can_admit(self, prompt: Sequence[int], max_new_tokens: int) -> bool:
         return True
+
+    def stats(self) -> dict:
+        return {"kv_quant": self.kv_quant}
 
 
 class BlockPool:
@@ -209,12 +241,17 @@ class PagedLayout:
     name = "paged"
 
     def __init__(self, max_slots: int, rows_per_slot: int, block_size: int,
-                 num_blocks: int, prefix_cache: bool = False):
+                 num_blocks: int, prefix_cache: bool = False,
+                 kv_quant: str = "none"):
         if block_size < 1:
             raise ValueError(f"kv_block_size must be >= 1, got {block_size}")
         self.max_slots = max_slots
         self.block_size = block_size
         self.rows_per_slot = rows_per_slot
+        #: KV storage scenario: scale blocks mirror the K/V pools
+        #: ([num_blocks, block_size, hkv] next to each pool) so block
+        #: ids index values and scales identically
+        self.kv_quant = kv_quant
         self.pages_per_slot = -(-rows_per_slot // block_size)
         self.r_pad = self.pages_per_slot * block_size
         self.prefix_enabled = prefix_cache
@@ -328,4 +365,5 @@ class PagedLayout:
     def stats(self) -> dict:
         s = self.pool.stats()
         s["kv_blocks_promised"] = sum(self._promised)
+        s["kv_quant"] = self.kv_quant
         return s

@@ -54,7 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.transformer_lm import TransformerLM, make_decode_cache
+from ..models.transformer_lm import KV_QUANTS, TransformerLM, make_decode_cache
 from .cache_layout import DenseLayout, PagedLayout
 
 __all__ = ["LMEngine", "DEFAULT_BUCKETS", "DEFAULT_KV_BLOCK_SIZE"]
@@ -150,6 +150,8 @@ class LMEngine:
         kv_blocks: int | None = None,
         prefill_chunk: int | None = None,
         prefix_cache: bool = False,
+        attention_impl: str = "xla",
+        kv_dtype: str | None = None,
     ):
         if model.moe_every:
             raise ValueError(
@@ -181,6 +183,16 @@ class LMEngine:
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        if attention_impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown attention_impl {attention_impl!r} (xla|pallas)")
+        kv_quant = kv_dtype or "none"
+        if kv_quant not in KV_QUANTS:
+            raise ValueError(
+                f"unknown kv_dtype {kv_dtype!r} "
+                f"(None|{'|'.join(q for q in KV_QUANTS if q != 'none')})")
+        self.attention_impl = attention_impl
+        self.kv_quant = kv_quant
         self.layout_name = layout
         self.max_slots = max_slots
         self.max_len = max_len
@@ -250,19 +262,26 @@ class LMEngine:
                 kv_blocks = max_slots * pages_per_slot
             self.layout = PagedLayout(
                 max_slots, self.kv_rows_per_slot, kv_block_size,
-                kv_blocks, prefix_cache=prefix_cache)
+                kv_blocks, prefix_cache=prefix_cache, kv_quant=kv_quant)
             paged_kw = dict(kv_block_size=kv_block_size, kv_blocks=kv_blocks)
         else:
-            self.layout = DenseLayout(max_slots, self.kv_rows_per_slot)
+            self.layout = DenseLayout(max_slots, self.kv_rows_per_slot,
+                                      kv_quant=kv_quant)
             paged_kw = dict()
         self.decode_model = model.clone(
             decode=True, slot_decode=True, attn_fn=None, dropout=0.0,
-            ring_slack=slack, **paged_kw)
+            ring_slack=slack, attention_impl=attention_impl,
+            kv_quant=kv_quant, **paged_kw)
         self.cache = make_decode_cache(self.decode_model, max_slots, max_len)
         if layout == "dense":
+            # the prefill program runs whole buckets/chunks (t > 1), so
+            # its attention stays XLA whatever the decode impl — but it
+            # must share the decode model's QUANT setting: the cache it
+            # fills is the cache the splice hands to the decode step
             self.prefill_model = model.clone(
                 decode=True, slot_decode=False, attn_fn=None, dropout=0.0,
-                ring_slack=slack)
+                ring_slack=slack, attention_impl=attention_impl,
+                kv_quant=kv_quant)
             # reusable zero template: _prefill never mutates its input,
             # so one template serves every admission
             self._prefill_zero = make_decode_cache(
@@ -335,7 +354,8 @@ class LMEngine:
                 # holds exactly what a batch-1 unpadded prefill of plen
                 # tokens would hold — the parity invariant
                 return bg.at[slot].set(jnp.where(sm < plen, sm, -1))
-            if name in ("cached_k", "cached_v"):
+            if name in ("cached_k", "cached_v",
+                        "cached_k_scale", "cached_v_scale"):
                 return bg.at[slot].set(sm[0])
             raise ValueError(f"unknown cache leaf {name!r}")
 
@@ -849,10 +869,9 @@ class LMEngine:
     # ---- reporting --------------------------------------------------------
 
     def pool_stats(self) -> dict:
-        """Block-pool occupancy and prefix-cache counters (empty for the
-        dense layout — it has no pool)."""
-        if self.layout_name != "paged":
-            return {}
+        """The layout's stats: block-pool occupancy and prefix-cache
+        counters for the paged layout; both layouts report their
+        ``kv_quant`` storage scenario."""
         return self.layout.stats()
 
     def kv_cache_bytes(self) -> dict:
@@ -862,7 +881,11 @@ class LMEngine:
         the gap between the two)."""
         total = 0
         for path, leaf in jax.tree_util.tree_flatten_with_path(self.cache)[0]:
-            if _leaf_name(path) in ("cached_k", "cached_v"):
+            # K/V rows plus their quantization scales (the scales are
+            # real HBM the quantized layouts pay — counting them keeps
+            # the bytes-per-token comparison honest)
+            if _leaf_name(path) in ("cached_k", "cached_v",
+                                    "cached_k_scale", "cached_v_scale"):
                 total += leaf.size * leaf.dtype.itemsize
         if self.layout_name != "paged":
             return {"reserved": total, "live": total}

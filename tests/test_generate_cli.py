@@ -24,6 +24,9 @@ def test_token_mode_random_init(capsys):
     assert all(0 <= t < 16 for t in toks)
 
 
+# slow tier: byte-mode rides the same CLI machinery as token mode
+# (fast); only the tokenizer wrapper differs
+@pytest.mark.slow
 def test_byte_mode_roundtrip(tmp_path, capsys):
     """Checkpoint round-trip: params saved by the trainer drive the
     sampler; byte prompt survives into the decoded output."""

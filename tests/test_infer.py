@@ -32,6 +32,9 @@ def test_showpreds_format_and_ranking():
     assert p1 > p2 > 0
 
 
+# slow tier: subprocess-scale CLI smoke (full vision forward compile);
+# the LM CLI smoke (test_generate_cli token mode) keeps CLI coverage fast
+@pytest.mark.slow
 def test_infer_cli_random_init(capsys):
     import infer
 

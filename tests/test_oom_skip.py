@@ -92,6 +92,9 @@ def test_oom_with_donated_state_raises():
         train(task, print_every=0, eval_every=0, logger=NullLogger())
 
 
+# slow tier: secondary cursor/logging assertions on a second full
+# trainer build; the core skip-and-continue behavior stays fast
+@pytest.mark.slow
 def test_oom_skip_advances_cursor_and_logs_global_index():
     """The skipped-step path advances the data cursor and records the
     skipped batch's global index — the bookkeeping resume-after-skip

@@ -44,7 +44,17 @@ def _ref(model, params, prompt, new):
     return list(np.asarray(out)[0])
 
 
-@pytest.mark.parametrize("config", sorted(CONFIGS))
+# tier-1 runs the plain axis; the window/GQA configs ride the slow job
+# (their engine-level parity is also covered there by test_serve_paged
+# and test_pallas_decode matrices, and windowed/GQA DECODE math stays
+# fast via the kernel parity tests + test_transformer_lm) — the tier-1
+# loop must hold the 870s verify window (ROADMAP)
+@pytest.mark.parametrize("config", [
+    "plain",
+    pytest.param("gqa", marks=pytest.mark.slow),
+    pytest.param("window_sinks", marks=pytest.mark.slow),
+    pytest.param("window_gqa", marks=pytest.mark.slow),
+])
 def test_parity_interleaved_admissions(config):
     """Engine output == sequential generate() for every request, with
     admissions arriving mid-flight and prompts spanning both buckets."""
@@ -194,6 +204,9 @@ def test_no_recompile_after_warmup():
     assert after["prefill_compiles"] == len(used)
 
 
+# slow tier: distributional sampling property (compiles its own
+# engine); greedy parity and backpressure stay fast
+@pytest.mark.slow
 def test_temperature_sampling_reproducible_and_valid():
     """temperature>0 rides per-request key streams: same seed -> same
     stream, tokens stay in-vocab; different seeds diverge (eventually)."""

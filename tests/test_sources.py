@@ -165,6 +165,9 @@ def test_fetch_artifact_remote_file_cached(artifact_server, tmp_path):
     assert again == local and len(requests) == n  # cache hit
 
 
+# slow tier: zip fetch + a second generate-CLI compile; the plain
+# fetch/roundtrip paths stay fast
+@pytest.mark.slow
 def test_fetch_checkpoint_zip_roundtrip_via_generate_cli(
         artifact_server, tmp_path, capsys):
     """The full satellite path (reference: pluto.jl:52-124 fetches a
