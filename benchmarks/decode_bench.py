@@ -128,6 +128,13 @@ def main():
     def prompts(c):
         return [rng.integers(0, args.vocab, plen).astype(np.int32) for _ in range(c)]
 
+    def pct_ms(hist, q, digits=2):
+        # NaN (empty histogram) must not leak into the JSON rows
+        import math
+
+        v = hist.percentile(q)
+        return None if math.isnan(v) else round(v * 1e3, digits)
+
     # idealized sequential baseline: the whole sampler under ONE jit so
     # repeated requests reuse a compiled program
     seq_fn = jax.jit(
@@ -168,6 +175,11 @@ def main():
         sched.generate_all(reqs)
         eng_sec = time.perf_counter() - t0
         m = sched.metrics()
+        # tail percentiles straight off the registry histograms via the
+        # SHARED bucket-percentile helper (obs.metrics) — the same math
+        # the /metrics p50/p95 rollup gauges render
+        ttft_hist = sched.registry.get("fdtpu_serve_ttft_seconds")
+        tbt_hist = sched.registry.get("fdtpu_serve_tbt_seconds")
         compiles_after = engine.compile_stats()
 
         seq_tps = c * new / seq_shipped_sec
@@ -192,6 +204,10 @@ def main():
             "steady_decode_tokens_per_sec": round(
                 m["decode_tokens_per_sec"], 2),
             "ttft_ms_avg": round(m["ttft_sec_avg"] * 1e3, 2),
+            "ttft_ms_p50": pct_ms(ttft_hist, 50),
+            "ttft_ms_p95": pct_ms(ttft_hist, 95),
+            "tbt_ms_p50": pct_ms(tbt_hist, 50, 3),
+            "tbt_ms_p95": pct_ms(tbt_hist, 95, 3),
             "decode_compiles": compiles_after["decode_compiles"],
             "prefill_compiles": compiles_after["prefill_compiles"],
             "no_recompile_after_warmup": bool(no_recompile),

@@ -1,12 +1,24 @@
 #!/usr/bin/env python
 """Measured pipeline-schedule scaling vs the (S-1)/(M+S-1) formula,
-GPipe (AD-derived backward) vs hand-scheduled 1F1B.
+GPipe (AD-derived backward) vs hand-scheduled 1F1B — with modeled-vs-
+measured bubble accounting from a cost-profile artifact.
 
 The GPipe schedule (parallel/pp.py:26-28) predicts utilization
 M/(M+S-1) for M microbatches over S stages.  This script times the
 pipelined LM forward+backward at M in {S, 2S, 4S, 8S} for either
 schedule (``--schedule gpipe|1f1b``) and reports per-microbatch cost
 scaling (VERDICT r3 weak #6).
+
+Bubble accounting (ROADMAP item 4): the run stages out the model for
+per-layer static costs (``obs.profile.lm_layer_costs``), fits the
+measured rows to separate steady per-microbatch cost from fixed
+fill/drain overhead, and reports the MODELED bubble fraction (schedule
+formula over the static per-stage costs) next to the MEASURED one per
+row (``obs.profile.bubble_report``).  ``--profile-out`` persists
+everything as a versioned, topology-fingerprinted Profile artifact;
+``--profile`` replays the report from a saved artifact without timing
+anything (rejecting cross-topology artifacts unless
+``--allow-mismatch``).
 
 What each substrate can show:
 
@@ -16,10 +28,13 @@ What each substrate can show:
   scan stores residuals for all M microbatches, so per-tick cost
   inflates with M (cache/allocator pressure), while 1F1B's fixed
   min(S,M)-slot input ring keeps per-microbatch cost ~flat — that
-  contrast is the point of the comparison here.
+  contrast is the point of the comparison here.  The measured-bubble
+  column follows suit: on real chips it is idle time, on the CPU mesh
+  it is the schedule's fixed-overhead fraction.
 
-    python benchmarks/pp_bubble.py --platform cpu --dim 128 --depth 8
-    python benchmarks/pp_bubble.py --platform cpu --schedule 1f1b
+    python benchmarks/pp_bubble.py --platform cpu --dim 128 --depth 8 \
+        --profile-out pp_profile.json
+    python benchmarks/pp_bubble.py --platform cpu --profile pp_profile.json
 """
 
 from __future__ import annotations
@@ -33,6 +48,48 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def report_from_artifact(args) -> None:
+    """``--profile``: modeled-vs-measured bubble report from a saved
+    artifact — no timing run, no model build."""
+    from fluxdistributed_tpu.obs.profile import (
+        Profile, ProfileMismatch, bubble_report,
+    )
+
+    prof = Profile.load(args.profile)
+    if args.allow_mismatch:
+        print(json.dumps({"note": "fingerprint check skipped "
+                                  "(--allow-mismatch)",
+                          "artifact_topology": prof.topology}))
+    else:
+        # rebuild the artifact's recorded topology so the fingerprint
+        # recipe can match; a box that cannot reproduce it is exactly
+        # the cross-topology case the check exists to reject
+        if args.platform == "cpu":
+            from fluxdistributed_tpu.mesh import force_host_devices
+
+            force_host_devices(int(prof.topology.get(
+                "device_count", args.devices)))
+        from fluxdistributed_tpu.mesh import make_mesh
+
+        try:
+            mesh_shape = prof.topology.get("mesh") or {}
+            prof.verify(make_mesh({k: int(v) for k, v in
+                                   mesh_shape.items()}) if mesh_shape
+                        else None)
+        except (ProfileMismatch, ValueError) as e:
+            raise SystemExit(
+                f"{e}\n(pass --allow-mismatch to analyze anyway)")
+    rows = bubble_report(prof)
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    print(json.dumps({
+        "metric": "pp bubble fraction, modeled vs measured "
+                  f"(from {args.profile})",
+        "schedule": prof.meta.get("schedule"),
+        "rows": rows,
+    }))
 
 
 def main():
@@ -53,10 +110,26 @@ def main():
                     help="gpipe only: lm_pp(remat=True) — per-tick input "
                          "checkpointing, the AD-side answer to the residual "
                          "blowup (compare against the 1f1b rows)")
+    ap.add_argument("--profile-out", default=None, metavar="PATH",
+                    help="persist this run (static per-layer costs + "
+                         "measured rows + topology fingerprint) as an "
+                         "obs.profile artifact the planner / a later "
+                         "--profile replay consumes")
+    ap.add_argument("--profile", default=None, metavar="PATH",
+                    help="skip the timing run: print the modeled-vs-"
+                         "measured bubble report from this saved "
+                         "artifact (topology-checked)")
+    ap.add_argument("--allow-mismatch", action="store_true",
+                    help="with --profile: analyze an artifact recorded "
+                         "on a DIFFERENT topology (numbers then "
+                         "describe that topology, not this box)")
     args = ap.parse_args()
     if args.remat and args.schedule != "gpipe":
         ap.error("--remat applies to --schedule gpipe only (1f1b always "
                  "recomputes from its input ring)")
+    if args.profile:
+        report_from_artifact(args)
+        return
 
     import jax
 
@@ -144,6 +217,39 @@ def main():
                   "pipeline: measured vs (S-1)/(M+S-1)",
         "platform": jax.devices()[0].platform,
         "rows": rows,
+    }))
+
+    # ---- modeled vs measured bubble accounting (obs.profile) ----------
+    # Static per-layer costs from the STAGED-OUT model (forward FLOPs;
+    # fwd+bwd scales every block ~uniformly, so the stage-cost RATIOS
+    # the schedule model needs are preserved) + the measured rows above,
+    # bundled as the topology-fingerprinted artifact the planner reads.
+    from fluxdistributed_tpu.compilation import topology_fingerprint
+    from fluxdistributed_tpu.obs.profile import (
+        Profile, bubble_report, describe_topology, lm_layer_costs,
+    )
+
+    prof = Profile(
+        fingerprint=topology_fingerprint(mesh=mesh),
+        topology=describe_topology(mesh),
+        static={"model": lm_layer_costs(model, args.mb_size, args.seqlen),
+                "step": None, "variants": {}},
+        measured={"pp_rows": rows},
+        meta={"schedule": args.schedule, "remat": bool(args.remat),
+              "mb_size": args.mb_size, "seqlen": args.seqlen,
+              "vocab": args.vocab, "producer": "benchmarks/pp_bubble.py"},
+    )
+    if args.profile_out:
+        prof.save(args.profile_out)
+        print(json.dumps({"profile_artifact": args.profile_out,
+                          "fingerprint": prof.fingerprint}), flush=True)
+    breport = bubble_report(prof)
+    print(json.dumps({
+        "metric": f"{args.schedule} pp bubble fraction, modeled "
+                  "(static per-stage costs through the schedule model) "
+                  "vs measured (fixed-cost share of wall time)",
+        "platform": jax.devices()[0].platform,
+        "rows": breport,
     }))
 
 

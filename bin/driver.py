@@ -163,6 +163,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="append registry snapshots (JSON lines) here at the "
                         "print cadence — offline run diffing without a "
                         "Prometheus server")
+    p.add_argument("--profile-out", default=None, metavar="PATH",
+                   help="write a versioned cost-profile artifact "
+                        "(obs.profile schema: static per-layer/step "
+                        "FLOPs+bytes from the staged-out program, the "
+                        "run's measured phase histograms, topology "
+                        "fingerprint) here when training ends — the "
+                        "input the pipeline planner and "
+                        "benchmarks/pp_bubble.py consume")
     p.add_argument("--steady-after", type=int, default=None, metavar="N",
                    help="declare XLA warmup over after N cycles: any later "
                         "compile is counted + warned as a steady-state "
@@ -556,6 +564,7 @@ def main(argv=None) -> int:
         device_sync=bool(args.trace_events),
         steady_after=args.steady_after,
         jsonl_path=args.metrics_jsonl,
+        profile_path=args.profile_out,
     )
     metrics_srv = None
     if args.metrics_port is not None and multihost.is_coordinator():

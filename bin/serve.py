@@ -157,6 +157,13 @@ def build_parser():
                    help="match the trainer's --norm")
     p.add_argument("--mlp", default="gelu", choices=["gelu", "swiglu"],
                    help="match the trainer's --mlp")
+    p.add_argument("--trace-requests", default=None, metavar="PATH",
+                   help="record request-scoped lifecycle events "
+                        "(enqueue/queue-wait/prefill chunks/first "
+                        "token/decode ticks/finish) in a bounded ring "
+                        "and write a Perfetto trace with one track per "
+                        "request here at shutdown; the live ring is "
+                        "also served at GET /trace (LM mode)")
     # cold-start controls (fluxdistributed_tpu.compilation)
     p.add_argument("--drain-timeout", type=float, default=30.0,
                    help="graceful-drain bound for --lm: on SIGTERM the "
@@ -244,7 +251,13 @@ def make_lm_app(args):
     if args.prewarm or args.aot_dir:
         print(f"engine ready in {time.perf_counter() - t0:.1f}s "
               f"(compile_stats={engine.compile_stats()})", file=sys.stderr)
-    scheduler = Scheduler(engine, max_queue=args.max_queue)
+    reqtrace = None
+    if getattr(args, "trace_requests", None):
+        from fluxdistributed_tpu.obs import RequestTracer
+
+        reqtrace = RequestTracer()
+    scheduler = Scheduler(engine, max_queue=args.max_queue,
+                          reqtrace=reqtrace)
     return LMServer(scheduler, args.vocab), scheduler
 
 
@@ -366,7 +379,7 @@ def serve(args, predict):
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.lm:
-        lm_server, _ = make_lm_app(args)
+        lm_server, scheduler = make_lm_app(args)
         srv = lm_server.serve(args.host, args.port)
         # SIGTERM → stop admissions, finish in-flight decodes (bounded),
         # shut the HTTP server down, exit 0 — the graceful-drain path
@@ -381,6 +394,11 @@ def main(argv=None) -> int:
             pass
         finally:
             lm_server.stop_loop()
+            if scheduler.reqtrace is not None:
+                n = scheduler.reqtrace.export_chrome_trace(
+                    args.trace_requests)
+                print(f"request trace ({n} events) written to "
+                      f"{args.trace_requests}", file=sys.stderr)
         return 0
     predict = make_app(args)
     srv = serve(args, predict)

@@ -676,24 +676,140 @@ def _check_axis_literal(ctx: ModuleContext) -> Iterable[Finding]:
 
 # -- FDT106: metric-name convention ---------------------------------------
 
+def _str_bindings(tree: ast.Module) -> Dict[str, str]:
+    """Names that resolve to exactly ONE compile-time string across the
+    whole module — the registration-prefix idiom (``METRIC_PREFIX =
+    "fdtpu_serve_"``; ``r, p = self.registry, METRIC_PREFIX``) that
+    FDT106 must see through.  Conservative on purpose: a name that is
+    ever a function parameter, a loop target, or assigned anything
+    unresolvable never resolves (a false "covered" is worse than a
+    skipped dynamic name)."""
+    raw: Dict[str, list] = {}
+
+    def poison(target) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                raw.setdefault(n.id, []).append(None)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            pairs = []
+            for t in node.targets:
+                if isinstance(t, ast.Tuple) and isinstance(
+                        node.value, ast.Tuple) and len(t.elts) == len(
+                        node.value.elts):
+                    pairs.extend(zip(t.elts, node.value.elts))
+                else:
+                    pairs.append((t, node.value))
+            for t, v in pairs:
+                if isinstance(t, ast.Name):
+                    raw.setdefault(t.id, []).append(v)
+                else:
+                    poison(t)
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name):
+            raw.setdefault(node.target.id, []).append(node.value)
+        elif isinstance(node, ast.AugAssign):
+            # PREFIX += "..." rebinds to a value this resolver does not
+            # model — the stale original must not keep resolving
+            poison(node.target)
+        elif isinstance(node, ast.NamedExpr):
+            poison(node.target)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            poison(node.target)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    poison(item.optional_vars)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            raw.setdefault(node.name, []).append(None)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                raw.setdefault(alias.asname or alias.name.split(".")[0],
+                               []).append(None)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            a = node.args
+            for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs,
+                        *((a.vararg,) if a.vararg else ()),
+                        *((a.kwarg,) if a.kwarg else ())):
+                raw.setdefault(arg.arg, []).append(None)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                poison(gen.target)
+    resolved: Dict[str, str] = {}
+    for _ in range(4):  # fixpoint: aliases of aliases settle in passes
+        changed = False
+        for name, vals in raw.items():
+            if name in resolved:
+                continue
+            out = set()
+            for v in vals:
+                s = _const_str(v, resolved) if v is not None else None
+                if s is None:
+                    out = None
+                    break
+                out.add(s)
+            if out and len(out) == 1:
+                resolved[name] = out.pop()
+                changed = True
+        if not changed:
+            break
+    return resolved
+
+
+def _const_str(node, bindings: Dict[str, str]) -> Optional[str]:
+    """Resolve an expression to a compile-time string (literal, resolved
+    name, ``+`` concatenation, f-string of resolvable parts) or None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return bindings.get(node.id)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _const_str(node.left, bindings)
+        right = _const_str(node.right, bindings)
+        return left + right if left is not None and right is not None else None
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            elif isinstance(v, ast.FormattedValue):
+                if v.format_spec is not None or v.conversion != -1:
+                    return None
+                s = _const_str(v.value, bindings)
+                if s is None:
+                    return None
+                parts.append(s)
+            else:
+                return None
+        return "".join(parts)
+    return None
+
+
 @ast_rule(
     "FDT106", "metric-name", "warning",
     "a metric registered off the byte-pinned `fdtpu_*` snake_case "
     "convention — dashboards and the obs/ exposition parity tests key "
-    "on the prefix.",
+    "on the prefix.  Prefix-constant concatenations (`METRIC_PREFIX + "
+    "\"queue_depth\"`) are resolved; truly dynamic names stay out of "
+    "scope.",
     "name it fdtpu_<subsystem>_<what>_<unit> (e.g. "
     "fdtpu_train_step_seconds)")
 def _check_metric_names(ctx: ModuleContext) -> Iterable[Finding]:
     rule = _rule_by_id("FDT106")
+    bindings = _str_bindings(ctx.tree)
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Attribute):
             continue
         if node.func.attr not in ("counter", "gauge", "histogram"):
             continue
-        if not node.args or not isinstance(node.args[0], ast.Constant) \
-                or not isinstance(node.args[0].value, str):
+        if not node.args:
             continue
-        name = node.args[0].value
+        name = _const_str(node.args[0], bindings)
+        if name is None:
+            continue
         if not _METRIC_NAME_RE.match(name):
             yield _finding(
                 rule, ctx, node,

@@ -33,10 +33,13 @@ from .metrics import (
     Histogram,
     JsonlSink,
     Registry,
+    bucket_percentile,
     get_registry,
 )
+from .profile import Profile, ProfileMismatch, collect_profile
+from .reqtrace import RequestTracer
 from .server import MetricsServer, start_metrics_server
-from .spans import SpanTracer, current_span
+from .spans import SpanTracer, current_span, innermost_active
 from .watchdog import StepWatchdog
 
 __all__ = [
@@ -46,11 +49,17 @@ __all__ = [
     "JsonlSink",
     "MetricsServer",
     "Observation",
+    "Profile",
+    "ProfileMismatch",
     "Registry",
+    "RequestTracer",
     "SpanTracer",
     "StepWatchdog",
+    "bucket_percentile",
+    "collect_profile",
     "current_span",
     "get_registry",
+    "innermost_active",
     "jaxmon",
     "start_metrics_server",
 ]
@@ -87,6 +96,10 @@ class Observation:
     # append a registry snapshot line here at the print cadence and at
     # exit (offline run diffing — no Prometheus server required)
     jsonl_path: Optional[str] = None
+    # write a versioned cost-profile artifact (obs.profile.Profile:
+    # static per-layer/step costs + the run's measured phase data) here
+    # when training ends — the planner-facing output of a profiled run
+    profile_path: Optional[str] = None
 
     @classmethod
     def default(cls) -> "Observation":
@@ -102,6 +115,7 @@ class Observation:
         watchdog_factor: float = 5.0,
         steady_after: Optional[int] = None,
         jsonl_path: Optional[str] = None,
+        profile_path: Optional[str] = None,
     ) -> "Observation":
         """Everything on: spans (the trainer feeds the phase histogram
         from the same brackets), stall watchdog, per-step device sync."""
@@ -114,4 +128,5 @@ class Observation:
             device_sync=True,
             steady_after=steady_after,
             jsonl_path=jsonl_path,
+            profile_path=profile_path,
         )

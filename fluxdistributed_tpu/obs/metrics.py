@@ -45,6 +45,7 @@ __all__ = [
     "Histogram",
     "JsonlSink",
     "Registry",
+    "bucket_percentile",
     "get_registry",
 ]
 
@@ -54,6 +55,44 @@ DEFAULT_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
 )
+
+
+def bucket_percentile(bounds: Sequence[float], counts: Sequence[int],
+                      q: float) -> float:
+    """Percentile ``q`` (0-100) estimated from histogram buckets — the
+    ONE percentile implementation every consumer shares (decode_bench,
+    the serve rollup gauges, the profile artifact) instead of each
+    rolling its own off-by-one bucket walk.
+
+    ``bounds`` are the finite upper bucket bounds (ascending);
+    ``counts`` are PER-BUCKET (non-cumulative) counts with one extra
+    trailing entry for the +Inf bucket, i.e. ``len(counts) ==
+    len(bounds) + 1`` — exactly a :class:`_HistogramCell`'s layout.
+    Linear interpolation inside the target bucket (lower edge 0 for the
+    first); a percentile landing in the +Inf bucket returns the largest
+    finite bound (the honest Prometheus ``histogram_quantile``
+    convention — the data says "bigger than everything we bin").
+    Returns NaN when the histogram is empty.
+    """
+    if not 0 <= q <= 100:
+        raise ValueError(f"percentile must be in [0, 100], got {q}")
+    if len(counts) != len(bounds) + 1:
+        raise ValueError(
+            f"counts must have one entry per bound plus +Inf "
+            f"({len(bounds) + 1}), got {len(counts)}")
+    total = sum(counts)
+    if total == 0:
+        return math.nan
+    rank = q / 100.0 * total
+    cum = 0.0
+    for i, b in enumerate(bounds):
+        prev_cum = cum
+        cum += counts[i]
+        if cum >= rank:
+            lo = bounds[i - 1] if i else 0.0
+            frac = (rank - prev_cum) / counts[i] if counts[i] else 0.0
+            return lo + frac * (b - lo)
+    return float(bounds[-1])  # landed in the +Inf bucket
 
 
 def _escape_label(v: str) -> str:
@@ -325,6 +364,30 @@ class Histogram(_Metric):
     def cell_count(self, *labelvalues) -> int:
         cell = self.labels(*labelvalues) if labelvalues else self._default_cell()
         return cell.count
+
+    def percentile(self, q: float, *labelvalues) -> float:
+        """Estimated percentile ``q`` (0-100) of one cell via
+        :func:`bucket_percentile`; NaN while the cell is empty."""
+        cell = self.labels(*labelvalues) if labelvalues else self._default_cell()
+        with cell._lock:
+            counts = list(cell.counts)
+        return bucket_percentile(self.buckets, counts, q)
+
+    def series(self) -> dict:
+        """Snapshot every cell as ``{label_tuple: {"sum", "count",
+        "bounds", "counts"}}`` (counts per-bucket incl. the trailing
+        +Inf entry) — the raw material the profile artifact persists so
+        offline consumers can recompute any percentile."""
+        out = {}
+        for lv, cell in self._series():
+            with cell._lock:
+                out[lv] = {
+                    "sum": cell.sum,
+                    "count": cell.count,
+                    "bounds": list(self.buckets),
+                    "counts": list(cell.counts),
+                }
+        return out
 
     def expose(self) -> list:
         out = []

@@ -1,0 +1,383 @@
+"""Per-layer / per-stage cost profiles: static jaxpr costs + measured
+phase wall-times, persisted as a versioned topology-fingerprinted JSON
+artifact.
+
+ROADMAP item 4's pipeline planner needs exactly two inputs nothing in
+the repo persisted until now: *what does each layer cost* (to place
+stage boundaries) and *what did the schedule actually spend* (to check
+the placement).  This module is that data layer:
+
+* **static costs** — FLOPs / bytes-accessed from XLA's own
+  ``cost_analysis`` on the staged-out (lowered, never compiled)
+  program.  :func:`lm_layer_costs` isolates the per-decoder-block cost
+  with a depth-difference: a homogeneous stack's cost is affine in
+  depth, so ``cost(depth=2) - cost(depth=1)`` is one block and the
+  remainder is the embed + head "outer" cost.  :func:`step_cost` prices
+  any prepared train step (the REAL ``prepare_training`` output), and
+  :func:`variant_costs` sweeps the registered parallelism variants
+  through ``analysis/variants.py`` — the same builders fdtpu-lint's
+  jaxpr layer checks.
+* **measured wall-times** — the span/phase histograms instrumented runs
+  already feed (``fdtpu_train_phase_seconds`` et al.), lifted out of a
+  registry with full bucket detail so offline consumers can recompute
+  any percentile via :func:`..obs.metrics.bucket_percentile`.
+
+The artifact (:class:`Profile`) carries a ``schema`` tag, the
+:func:`..compilation.topology_fingerprint` digest plus a human-readable
+topology block, and rejects cross-topology reuse at load time
+(:meth:`Profile.verify` raises :class:`ProfileMismatch`): a profile
+measured on 8 CPU devices must never silently drive stage placement on
+a v5e slice.
+
+Schema (``fdtpu-profile/v1``)::
+
+    {"schema": "fdtpu-profile/v1", "created_unix": ...,
+     "fingerprint": "<16-hex topology digest>",
+     "topology": {"jax", "platform", "device_kind",
+                  "device_count", "process_count", "mesh"},
+     "static": {"model": {"batch", "seqlen", "depth",
+                          "block": {"flops", "bytes"},
+                          "outer": {"flops", "bytes"},
+                          "total": {"flops", "bytes"}} | null,
+                "step":  {"flops", "bytes"} | null,
+                "variants": {name: {"flops", "bytes"}}},
+     "measured": {"phases": {phase: {"sum", "count",
+                                     "bounds", "counts"}},
+                  "step_seconds": {...}, "counters": {...},
+                  "pp_rows": [...]},          # pp_bubble.py runs only
+     "meta": {...}}
+
+Consumers today: ``benchmarks/pp_bubble.py`` (modeled-vs-measured
+bubble accounting via :func:`bubble_report`), ``bin/driver.py
+--profile-out`` (trainer runs), and — next — the profile-guided stage
+partitioner (docs/parallelism.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .metrics import Histogram, Registry, get_registry
+
+__all__ = [
+    "Profile",
+    "ProfileMismatch",
+    "bubble_report",
+    "collect_profile",
+    "describe_topology",
+    "lm_layer_costs",
+    "measured_from_registry",
+    "step_cost",
+    "variant_costs",
+]
+
+SCHEMA = "fdtpu-profile/v1"
+
+
+class ProfileMismatch(ValueError):
+    """A profile artifact's topology fingerprint does not match the
+    consuming process — its costs describe DIFFERENT hardware."""
+
+
+def describe_topology(mesh=None) -> dict:
+    """Human-readable sibling of the opaque fingerprint digest, stored
+    alongside it so a rejected artifact can say WHAT differed."""
+    import jax
+
+    dev = jax.devices()[0]
+    out = {
+        "jax": jax.__version__,
+        "platform": dev.platform,
+        "device_kind": str(getattr(dev, "device_kind", "")),
+        "device_count": jax.device_count(),
+        "process_count": jax.process_count(),
+    }
+    if mesh is not None:
+        out["mesh"] = {k: int(v) for k, v in dict(mesh.shape).items()}
+    return out
+
+
+def _normalize_cost(ca) -> Optional[dict]:
+    """``cost_analysis`` returns a dict on this jax, a one-element list
+    of dicts on others, and occasionally None (backend without a cost
+    model) — normalize to ``{"flops", "bytes"}`` floats or None."""
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    return {
+        "flops": float(ca.get("flops", 0.0)),
+        "bytes": float(ca.get("bytes accessed", 0.0)),
+    }
+
+
+def step_cost(fn, args: Tuple[Any, ...]) -> Optional[dict]:
+    """FLOPs/bytes of one jit-wrapped program at these arguments via
+    ``lower(...).cost_analysis()`` — staging only, nothing compiles.
+    Returns None when the callable cannot lower (AOT-deserialized
+    executables, strict-check wrappers): a missing static cost must
+    degrade the artifact, not kill the run that produced it."""
+    try:
+        return _normalize_cost(fn.lower(*args).cost_analysis())
+    except Exception:  # noqa: BLE001 — any non-lowerable fn is a None
+        return None
+
+
+def _model_forward_cost(model, tokens_shape) -> Optional[dict]:
+    import jax
+    import jax.numpy as jnp
+
+    toks = jax.ShapeDtypeStruct(tuple(tokens_shape), jnp.int32)
+    variables = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, tokens_shape[1]), jnp.int32), train=False))
+    low = jax.jit(
+        lambda v, t: model.apply(v, t, train=False)).lower(variables, toks)
+    return _normalize_cost(low.cost_analysis())
+
+
+def lm_layer_costs(model, batch_size: int, seqlen: int) -> Optional[dict]:
+    """Per-decoder-block and outer (embed + head) forward cost of a
+    :class:`~..models.transformer_lm.TransformerLM` at ``(batch_size,
+    seqlen)``, via the depth-difference on the staged-out model: the
+    stack is homogeneous, so ``cost(d=2) - cost(d=1)`` isolates one
+    block exactly and needs no model surgery.  Returns None when the
+    model cannot lower standalone (e.g. a mesh-bound moe_fn outside its
+    mesh)."""
+    depth = int(getattr(model, "depth", 0))
+    if depth < 1:
+        return None
+    try:
+        c1 = _model_forward_cost(model.clone(depth=1),
+                                 (batch_size, seqlen))
+        c2 = _model_forward_cost(model.clone(depth=2),
+                                 (batch_size, seqlen))
+    except Exception:  # noqa: BLE001 — profile collection is best-effort
+        return None
+    if c1 is None or c2 is None:
+        return None
+    block = {k: max(c2[k] - c1[k], 0.0) for k in c1}
+    outer = {k: max(c1[k] - block[k], 0.0) for k in c1}
+    return {
+        "batch": int(batch_size),
+        "seqlen": int(seqlen),
+        "depth": depth,
+        "block": block,
+        "outer": outer,
+        "total": {k: outer[k] + depth * block[k] for k in block},
+    }
+
+
+def variant_costs(names: Optional[Sequence[str]] = None) -> Dict[str, dict]:
+    """Static step cost of every registered parallelism/serve variant,
+    built through the REAL ``prepare_training`` / ``LMEngine`` paths in
+    :mod:`..analysis.variants` — the same targets the lint suite's
+    jaxpr layer sweeps, so what gets priced is exactly what a real run
+    compiles.  Expensive (builds each variant on the virtual mesh);
+    meant for offline artifact generation, not hot paths."""
+    from ..analysis.variants import build_variants
+
+    return {v.name: step_cost(v.fn, v.args) for v in build_variants(names)}
+
+
+def measured_from_registry(registry: Optional[Registry] = None) -> dict:
+    """Lift the measured side out of a metrics registry: the per-phase
+    histogram with full bucket detail, the per-item step histogram, and
+    the headline counters.  Zero-risk read — snapshots only."""
+    reg = registry or get_registry()
+    out: dict = {}
+    ph = reg.get("fdtpu_train_phase_seconds")
+    if isinstance(ph, Histogram):
+        out["phases"] = {lv[0]: cell for lv, cell in ph.series().items()
+                         if lv and cell["count"]}
+    st = reg.get("fdtpu_train_step_seconds")
+    if isinstance(st, Histogram):
+        cell = st.series().get(())
+        if cell is not None and cell["count"]:
+            out["step_seconds"] = cell
+    counters = {}
+    for name in ("fdtpu_train_steps_total", "fdtpu_train_oom_skipped_total",
+                 "fdtpu_jax_compiles_total",
+                 "fdtpu_jax_compile_seconds_total"):
+        v = reg.value(name)
+        if v:
+            counters[name] = v
+    if counters:
+        out["counters"] = counters
+    return out
+
+
+@dataclasses.dataclass
+class Profile:
+    """The versioned cost-profile artifact (schema in the module doc)."""
+
+    fingerprint: str
+    topology: dict = dataclasses.field(default_factory=dict)
+    static: dict = dataclasses.field(default_factory=dict)
+    measured: dict = dataclasses.field(default_factory=dict)
+    meta: dict = dataclasses.field(default_factory=dict)
+    schema: str = SCHEMA
+    created_unix: float = 0.0
+
+    # -- persistence ---------------------------------------------------
+    def save(self, path: str) -> str:
+        """Write the artifact (write-then-rename so a cut-short run
+        never leaves a half-written JSON a planner could half-read)."""
+        doc = dataclasses.asdict(self)
+        doc["created_unix"] = self.created_unix or time.time()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "Profile":
+        with open(path) as f:
+            doc = json.load(f)
+        schema = doc.get("schema")
+        if schema != SCHEMA:
+            raise ValueError(
+                f"{path}: not a {SCHEMA} artifact (schema={schema!r}) — "
+                "regenerate it with this repo's profiler")
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in doc.items() if k in fields})
+
+    # -- topology gate -------------------------------------------------
+    def verify(self, mesh=None, tag: str = "") -> "Profile":
+        """Raise :class:`ProfileMismatch` unless this artifact was
+        recorded on THE topology the calling process runs on (same
+        fingerprint recipe as the AOT executable keys: jax/jaxlib
+        versions, platform, device kind and counts, mesh shape, tag).
+        Returns self so loads chain: ``Profile.load(p).verify(mesh)``."""
+        from ..compilation import topology_fingerprint
+
+        current = topology_fingerprint(mesh=mesh, tag=tag)
+        if current != self.fingerprint:
+            raise ProfileMismatch(
+                f"profile fingerprint {self.fingerprint} does not match "
+                f"this process ({current}): artifact topology "
+                f"{self.topology} vs current {describe_topology(mesh)} — "
+                "cost profiles do not transfer across topologies; "
+                "re-collect on this one")
+        return self
+
+
+def collect_profile(task=None, registry: Optional[Registry] = None,
+                    batch=None, meta: Optional[dict] = None) -> Profile:
+    """Build a :class:`Profile` from a prepared/finished training task:
+    topology from the task's mesh, static costs from the staged-out
+    model and the REAL compiled step (both best-effort — a wrapper that
+    cannot lower degrades to null, never raises), measured data from
+    the registry's phase histograms.  ``batch`` supplies the argument
+    shapes for the step cost and (for token batches) the layer costs;
+    the trainer passes its last live batch."""
+    from ..compilation import topology_fingerprint
+
+    mesh = getattr(task, "mesh", None)
+    prof = Profile(
+        fingerprint=topology_fingerprint(mesh=mesh),
+        topology=describe_topology(mesh),
+        measured=measured_from_registry(registry),
+        meta=dict(meta or {}),
+    )
+    static: dict = {"model": None, "step": None, "variants": {}}
+    model = getattr(task, "model", None)
+    if model is not None:
+        prof.meta.setdefault("model", type(model).__name__)
+    tokens = batch.get("tokens") if isinstance(batch, dict) else None
+    if model is not None and tokens is not None:
+        shape = tuple(getattr(tokens, "shape", ()))
+        if len(shape) >= 2:
+            # device-loop items stack K batches; the per-step shape is
+            # the trailing two dims either way
+            static["model"] = lm_layer_costs(model, shape[-2], shape[-1])
+    if task is not None and batch is not None:
+        static["step"] = step_cost(task.step_fn, (task.state, batch))
+    prof.static = static
+    return prof
+
+
+# -- modeled vs measured bubble accounting ---------------------------------
+
+def modeled_bubble(stage_costs: Sequence[float], num_microbatches: int) -> float:
+    """Pipeline bubble fraction the schedule model predicts for these
+    per-stage costs: steady state is bottlenecked by the most expensive
+    stage, fill+drain add S-1 of its ticks, so utilization is
+    ``M * mean(stage) / ((M + S - 1) * max(stage))`` and the bubble is
+    one minus that.  Uniform stages reduce it to the classic
+    ``(S-1)/(M+S-1)``."""
+    S = len(stage_costs)
+    if S < 1:
+        return 0.0
+    mx = max(stage_costs)
+    if mx <= 0:
+        return 0.0
+    mean = sum(stage_costs) / S
+    M = num_microbatches
+    return 1.0 - (M * mean) / ((M + S - 1) * mx)
+
+
+def stage_costs_from_static(model_costs: dict, S: int) -> List[float]:
+    """Split a profile's per-layer static costs into S contiguous stage
+    cost sums the way ``lm_pp`` places them: ``depth`` uniform blocks
+    dealt round-floor with the remainder on the leading stages, the
+    outer (embed + head) cost split between first and last stage."""
+    depth = int(model_costs["depth"])
+    block = float(model_costs["block"]["flops"])
+    outer = float(model_costs["outer"]["flops"])
+    per_stage = [(depth // S + (1 if i < depth % S else 0)) * block
+                 for i in range(S)]
+    per_stage[0] += outer / 2
+    per_stage[-1] += outer / 2
+    return per_stage
+
+
+def bubble_report(profile: Profile) -> List[dict]:
+    """Modeled-vs-measured bubble fractions from a pp_bubble artifact.
+
+    Measured side: the stored rows time the whole fwd+bwd at several M,
+    so a least-squares fit ``t_step(M) = a·M + b`` separates the
+    per-microbatch steady cost ``a`` from the fixed fill/drain/dispatch
+    cost ``b``; each row's measured bubble is the fixed share of its
+    own wall time, ``1 - a·M / t_meas``.  (On a real multi-chip slice
+    that IS idle-device time; on the shared-core CPU mesh — where
+    devices are never idle — it reads the schedule's fixed overhead
+    fraction, the honest analog.)  Modeled side: per-stage static costs
+    (:func:`stage_costs_from_static` when the artifact has layer costs,
+    uniform stages otherwise) through :func:`modeled_bubble`.
+    """
+    rows = profile.measured.get("pp_rows") or []
+    if len(rows) < 2:
+        raise ValueError(
+            "bubble accounting needs >= 2 measured M rows in the "
+            "artifact (run benchmarks/pp_bubble.py --profile-out first)")
+    ms = [float(r["M"]) for r in rows]
+    ts = [float(r["step_ms"]) for r in rows]
+    n = len(rows)
+    mean_m, mean_t = sum(ms) / n, sum(ts) / n
+    denom = sum((m - mean_m) ** 2 for m in ms)
+    a = (sum((m - mean_m) * (t - mean_t) for m, t in zip(ms, ts)) / denom
+         if denom else 0.0)
+    b = mean_t - a * mean_m
+    model_costs = (profile.static or {}).get("model")
+    out = []
+    for r, t in zip(rows, ts):
+        S, M = int(r["S"]), int(r["M"])
+        stages = (stage_costs_from_static(model_costs, S)
+                  if model_costs else [1.0] * S)
+        measured = min(max(1.0 - (a * M) / t, 0.0), 1.0) if t > 0 else 0.0
+        out.append({
+            "M": M, "S": S,
+            "step_ms": round(t, 2),
+            "modeled_bubble": round(modeled_bubble(stages, M), 4),
+            "measured_bubble": round(measured, 4),
+            "fit_ms_per_microbatch": round(a, 4),
+            "fit_fixed_ms": round(b, 4),
+        })
+    return out

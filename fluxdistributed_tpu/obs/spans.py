@@ -26,7 +26,9 @@ labeled by phase, so the SAME brackets produce both the live
 
 from __future__ import annotations
 
+import contextlib
 import contextvars
+import itertools
 import json
 import threading
 import time
@@ -35,7 +37,7 @@ from typing import Optional
 
 from .metrics import Histogram
 
-__all__ = ["SpanTracer", "current_span"]
+__all__ = ["SpanTracer", "current_span", "innermost_active", "phase_scope"]
 
 # name of the innermost open span in this context ("" at top level);
 # contextvars give correct nesting across threads AND async contexts
@@ -48,6 +50,61 @@ def current_span() -> Optional[str]:
     """Innermost open span name in the calling context, or ``None``."""
     s = _stack.get()
     return s[-1] if s else None
+
+
+# -- cross-thread active-span registry -------------------------------------
+# The contextvar above answers "where am I" for the CALLING context; the
+# stall watchdog needs "where is the LOOP" from its own daemon thread.
+# Every open span (and every tracer-less phase bracket via phase_scope)
+# also registers here: {thread_id: [(seq, name), ...]}, where seq is a
+# global open-order counter so "innermost" is well-defined across
+# threads.  One small lock + list op per span — phases tick a handful of
+# times per step, never per token.
+_active_lock = threading.Lock()
+_active: dict = {}
+_active_seq = itertools.count(1)
+
+
+def _active_push(name: str) -> None:
+    tid = threading.get_ident()
+    with _active_lock:
+        _active.setdefault(tid, []).append((next(_active_seq), name))
+
+
+def _active_pop() -> None:
+    tid = threading.get_ident()
+    with _active_lock:
+        stack = _active.get(tid)
+        if stack:
+            stack.pop()
+        if not stack:
+            _active.pop(tid, None)
+
+
+def innermost_active() -> Optional[str]:
+    """Name of the most recently OPENED still-open span/phase across all
+    threads, or ``None`` — what the stall watchdog reports as "where the
+    loop is wedged" (a stalled step is, by definition, inside whichever
+    bracket opened last and never closed)."""
+    with _active_lock:
+        newest, name = 0, None
+        for stack in _active.values():
+            if stack and stack[-1][0] > newest:
+                newest, name = stack[-1]
+    return name
+
+
+@contextlib.contextmanager
+def phase_scope(name: str):
+    """Register ``name`` as the active phase WITHOUT a tracer: the
+    metrics-only trainer path brackets its phases with this so the
+    watchdog can still name where a stall happened (no event buffer, no
+    histogram — just the active-span registry above)."""
+    _active_push(name)
+    try:
+        yield
+    finally:
+        _active_pop()
 
 
 class _NullSpan:
@@ -75,11 +132,13 @@ class _Span:
 
     def __enter__(self):
         self._token = _stack.set(_stack.get() + (self.name,))
+        _active_push(self.name)
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
         t1 = time.perf_counter()
+        _active_pop()
         _stack.reset(self._token)
         self._tracer._record(self.name, self._t0, t1, self.args)
         return False

@@ -9,7 +9,11 @@ time since the last beat against ``factor ×`` the **rolling median**
 step time (median, not mean: one slow checkpoint step must not inflate
 the baseline), and a breach fires ``on_stall`` — by default a warning
 plus ``fdtpu_watchdog_stalls_total`` in the registry, so a scraper can
-alert on it remotely.
+alert on it remotely.  The warning names the innermost ACTIVE
+span/phase at stall time (:func:`..obs.spans.innermost_active` — the
+trainer's phase brackets register there even without a tracer), so an
+episode says "stalled in 'dispatch'" instead of just "stalled";
+:attr:`last_where` keeps it readable for ``on_stall`` callbacks.
 
 The existing OOM-skip counter folds in through :meth:`note_skip`: a
 skipped batch both keeps the heartbeat alive (the loop IS making
@@ -98,6 +102,9 @@ class StepWatchdog:
             "batches skipped by OOM fault tolerance",
         )
         self._stalled.set(0)
+        #: innermost active span/phase at the most recent stall fire
+        #: (None when nothing was bracketed) — set BEFORE on_stall runs
+        self.last_where: Optional[str] = None
 
     # -- loop side -----------------------------------------------------
     def beat(self) -> None:
@@ -170,6 +177,11 @@ class StepWatchdog:
             if self._fired:  # lost the race with another poll
                 return False
             self._fired = True
+        from .spans import innermost_active
+
+        self.last_where = innermost_active()
+        where = (f" — stalled inside span/phase {self.last_where!r}"
+                 if self.last_where else "")
         self._stalls.inc()
         self._stalled.set(1)
         if self.on_stall is not None:
@@ -179,7 +191,7 @@ class StepWatchdog:
                 f"obs.watchdog: STALL — no step for {elapsed:.1f}s "
                 f"(threshold {thr:.1f}s = {self.factor} x median step); "
                 "a collective, the data loader, or a checkpoint write "
-                "may be wedged",
+                f"may be wedged{where}",
                 file=sys.stderr,
             )
         return True

@@ -87,9 +87,10 @@ class _PrefillState:
     chunk per call so a long prompt interleaves with decode ticks."""
 
     __slots__ = ("slot", "tokens", "temperature", "key", "plen", "pos",
-                 "small", "padded")
+                 "small", "padded", "rid")
 
-    def __init__(self, slot, tokens, temperature, key, pos=0, small=None):
+    def __init__(self, slot, tokens, temperature, key, pos=0, small=None,
+                 rid=None):
         self.slot = slot
         self.tokens = [int(t) for t in tokens]
         self.temperature = float(temperature)
@@ -98,6 +99,8 @@ class _PrefillState:
         self.pos = pos        # next prompt position to process
         self.small = small    # dense layout: carried batch-1 cache
         self.padded = 0       # padded tokens computed so far
+        self.rid = rid        # request trace id (obs.reqtrace) — pure
+        #                       host metadata; never enters a program
 
 
 class LMEngine:
@@ -698,14 +701,18 @@ class LMEngine:
 
     def prefill_begin(self, slot: int, tokens: Sequence[int],
                       temperature: float, key: np.ndarray,
-                      max_new_tokens: Optional[int] = None) -> _PrefillState:
+                      max_new_tokens: Optional[int] = None,
+                      rid: Optional[str] = None) -> _PrefillState:
         """Start prefilling ``tokens`` into ``slot``; the scheduler
         advances the returned state one chunk per :meth:`prefill_step`
         call (interleaving chunks with decode ticks).  ``max_new_tokens``
         sizes the paged worst-case reservation (default: the whole slot
         budget) — pass the request's real bound so the reservation
-        matches what :meth:`can_admit` agreed to."""
-        st = _PrefillState(slot, tokens, temperature, key)
+        matches what :meth:`can_admit` agreed to.  ``rid`` is the
+        request's trace id (obs.reqtrace): it rides this state so
+        engine-side chunk advances stay attributable to the request —
+        host metadata only, never an input to a compiled program."""
+        st = _PrefillState(slot, tokens, temperature, key, rid=rid)
         if self.layout_name == "paged":
             budget = (self.max_len - st.plen if max_new_tokens is None
                       else max_new_tokens)

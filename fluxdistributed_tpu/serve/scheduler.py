@@ -47,6 +47,7 @@ import jax
 import numpy as np
 
 from ..obs.metrics import Registry
+from ..obs.reqtrace import RequestTracer
 from .engine import LMEngine
 
 __all__ = ["Request", "Scheduler", "QueueFull", "Draining"]
@@ -81,6 +82,10 @@ class Request:
     # called from the scheduler thread per emitted token (streaming)
     on_token: Optional[Callable[["Request", int], None]] = None
     id: int = field(default_factory=lambda: next(_ids))
+    # caller-supplied trace id (the HTTP layer forwards X-Request-Id
+    # here); every reqtrace event for this request lands on the track
+    # it names — None falls back to the scheduler id (see trace_id)
+    rid: Optional[str] = None
 
     # scheduler-owned state
     generated: List[int] = field(default_factory=list)
@@ -89,12 +94,19 @@ class Request:
     slot: Optional[int] = None
     done: threading.Event = field(default_factory=threading.Event)
     submitted_at: Optional[float] = None
+    admitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
+    last_token_at: Optional[float] = None
     finished_at: Optional[float] = None
 
     def __post_init__(self):
         self.prompt = [int(t) for t in self.prompt]
         self._key = np.asarray(jax.random.PRNGKey(self.seed))
+
+    @property
+    def trace_id(self) -> str:
+        """The id request-scoped events carry end-to-end."""
+        return self.rid if self.rid is not None else str(self.id)
 
     @property
     def tokens(self) -> List[int]:
@@ -110,7 +122,8 @@ class Scheduler:
 
     def __init__(self, engine: LMEngine, max_queue: int = 64,
                  registry: Optional[Registry] = None,
-                 prefill_chunks_per_tick: int = 1):
+                 prefill_chunks_per_tick: int = 1,
+                 reqtrace: Optional[RequestTracer] = None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         if prefill_chunks_per_tick < 1:
@@ -131,6 +144,9 @@ class Scheduler:
         self._lock = threading.Lock()
         self._work = threading.Event()
         self.slots: List[Optional[Request]] = [None] * engine.max_slots
+        #: request-scoped lifecycle tracer (obs.reqtrace), or None —
+        #: events cost nothing when absent, a bounded ring when present
+        self.reqtrace = reqtrace
         self.registry = registry if registry is not None else Registry()
         r, p = self.registry, METRIC_PREFIX
         c, g = r.counter, r.gauge
@@ -147,6 +163,16 @@ class Scheduler:
         self._c_ttft_count = c(p + "ttft_count", "requests that produced a first token")
         self._h_ttft = r.histogram(
             p + "ttft_seconds", "time-to-first-token distribution")
+        # the per-request latency truth the N-replica router needs and
+        # aggregate counters cannot give: how long requests WAIT before
+        # a slot admits them, and the inter-token (TBT) cadence once
+        # they decode — both full histograms next to the TTFT one
+        self._h_queue_wait = r.histogram(
+            p + "queue_wait_seconds",
+            "submit-to-admission wait distribution")
+        self._h_tbt = r.histogram(
+            p + "tbt_seconds",
+            "inter-token (time-between-tokens) distribution")
         # chunked-prefill + paged-pool series (all zero / static for a
         # dense whole-prefill engine — the names are registered either
         # way so scrapes and close() are layout-independent)
@@ -194,6 +220,16 @@ class Scheduler:
         ):
             g(p + key, txt).set_function(
                 lambda key=key: float(self._pool_stat(key)))
+        # latency percentile rollups, computed AT SCRAPE TIME from the
+        # histograms via the shared bucket_percentile helper (NaN while
+        # empty — absence-of-data must not read as zero latency)
+        for hist, stem in ((self._h_queue_wait, "queue_wait_sec"),
+                           (self._h_tbt, "tbt_sec"),
+                           (self._h_ttft, "ttft_hist_sec")):
+            for q in (50, 95):
+                g(p + f"{stem}_p{q}",
+                  f"p{q} of {hist.name} (bucket-estimated)").set_function(
+                    lambda hist=hist, q=q: hist.percentile(q))
         self._callback_gauges = [
             p + k for k in (
                 "queue_depth", "active_slots", "max_slots",
@@ -201,6 +237,9 @@ class Scheduler:
                 "ttft_sec_avg", "decode_compiles", "prefill_compiles",
                 "insert_compiles", "kv_blocks_total", "kv_blocks_free",
                 "kv_blocks_active", "kv_blocks_cached",
+                "queue_wait_sec_p50", "queue_wait_sec_p95",
+                "tbt_sec_p50", "tbt_sec_p95",
+                "ttft_hist_sec_p50", "ttft_hist_sec_p95",
             )
         ]
 
@@ -248,6 +287,10 @@ class Scheduler:
         """Stop admissions for graceful shutdown.  Requests already
         accepted (queued or decoding) run to completion — bounding that
         is the caller's job (:meth:`LMServer.drain`'s timeout)."""
+        if self.reqtrace is not None:
+            self.reqtrace.event("scheduler", "drain_begin",
+                                active=self.active_slots,
+                                queued=self.queue_depth)
         self.draining = True
         self.registry.gauge(
             "fdtpu_serve_draining",
@@ -273,6 +316,13 @@ class Scheduler:
             req.submitted_at = time.monotonic()
             self._queue.append(req)
             self._c_submitted.inc()
+            depth = len(self._queue)
+        if self.reqtrace is not None:
+            self.reqtrace.event(req.trace_id, "enqueue",
+                                ts=req.submitted_at,
+                                prompt_tokens=len(req.prompt),
+                                max_new_tokens=req.max_new_tokens,
+                                queue_depth=depth)
         self._work.set()
         return req
 
@@ -297,6 +347,13 @@ class Scheduler:
                     req.state = "done"
                     req.finished_at = time.monotonic()
                     self._c_cancelled.inc()
+                    if self.reqtrace is not None:
+                        # a queued cancel must close its track too — an
+                        # enqueue with no terminal event reads as a
+                        # lost request in the timeline
+                        self.reqtrace.event(req.trace_id, "cancel",
+                                            ts=req.finished_at,
+                                            generated=0)
                     req.done.set()
                     return True
             if req.state == "done":
@@ -316,7 +373,23 @@ class Scheduler:
                 r.state = "done"
                 r.finished_at = time.monotonic()
                 self._c_cancelled.inc()
+                if self.reqtrace is not None:
+                    self.reqtrace.event(r.trace_id, "cancel",
+                                        ts=r.finished_at,
+                                        generated=len(r.generated))
                 r.done.set()
+
+    def _admitted(self, req: Request) -> None:
+        """Admission bookkeeping shared by both prefill paths: stamp
+        the admission, observe the queue wait, close the request's
+        queue_wait span."""
+        now = time.monotonic()
+        req.admitted_at = now
+        if req.submitted_at is not None:
+            self._h_queue_wait.observe(now - req.submitted_at)
+            if self.reqtrace is not None:
+                self.reqtrace.span(req.trace_id, "queue_wait",
+                                   req.submitted_at, now)
 
     # ---- driver side (one thread) -----------------------------------------
 
@@ -346,8 +419,14 @@ class Scheduler:
         if live:
             t0 = time.monotonic()
             nxt = self.engine.step_decode()
-            self._c_decode_sec.inc(time.monotonic() - t0)
+            t1 = time.monotonic()
+            self._c_decode_sec.inc(t1 - t0)
             self._c_decode_tokens.inc(len(live))
+            if self.reqtrace is not None:
+                # the engine-program dispatch on its own scheduler lane:
+                # request tracks show WHOSE token, this shows the tick
+                self.reqtrace.span("scheduler", "decode_step", t0, t1,
+                                   live=len(live))
             for s in live:
                 self._emit(self.slots[s], int(nxt[s]))
                 emitted += 1
@@ -372,10 +451,14 @@ class Scheduler:
                         and not can_admit(req.prompt, req.max_new_tokens)):
                     break
                 self._queue.popleft()
+            self._admitted(req)
             if incremental:
+                # the request id rides INTO the engine on the prefill
+                # state, so engine-side chunk advances stay attributable
                 req._pf = self.engine.prefill_begin(
                     free, req.prompt, req.temperature, req._key,
-                    max_new_tokens=req.max_new_tokens)
+                    max_new_tokens=req.max_new_tokens,
+                    rid=req.trace_id)
                 req.state = "prefilling"
                 req.slot = free
                 self.slots[free] = req
@@ -383,9 +466,13 @@ class Scheduler:
             t0 = time.monotonic()
             first, bucket = self.engine.prefill(
                 free, req.prompt, req.temperature, req._key)
-            self._c_prefill_sec.inc(time.monotonic() - t0)
+            t1 = time.monotonic()
+            self._c_prefill_sec.inc(t1 - t0)
             self._c_prefill_tokens.inc(len(req.prompt))
             self._c_prefill_padded.inc(bucket)
+            if self.reqtrace is not None:
+                self.reqtrace.span(req.trace_id, "prefill", t0, t1,
+                                   tokens=len(req.prompt), padded=bucket)
             req.state = "active"
             req.slot = free
             self.slots[free] = req
@@ -405,10 +492,16 @@ class Scheduler:
                 req = self.slots[s]
                 t0 = time.monotonic()
                 first, nreal, npad = self.engine.prefill_step(req._pf)
-                self._c_prefill_sec.inc(time.monotonic() - t0)
+                t1 = time.monotonic()
+                self._c_prefill_sec.inc(t1 - t0)
                 self._c_prefill_tokens.inc(nreal)
                 self._c_prefill_padded.inc(npad)
                 self._c_prefill_chunks.inc()
+                if self.reqtrace is not None:
+                    self.reqtrace.span(
+                        req.trace_id, "prefill_chunk", t0, t1,
+                        pos=getattr(req._pf, "pos", None),
+                        tokens=nreal, padded=npad)
                 chunks_run += 1
                 if first is not None:
                     req.state = "active"
@@ -446,6 +539,17 @@ class Scheduler:
                 self._c_ttft_sum.inc(ttft)
                 self._c_ttft_count.inc()
                 self._h_ttft.observe(ttft)
+            if self.reqtrace is not None:
+                self.reqtrace.event(req.trace_id, "first_token", ts=now)
+        else:
+            if req.last_token_at is not None:
+                self._h_tbt.observe(now - req.last_token_at)
+            if self.reqtrace is not None:
+                # decode ticks on the request's own track — bounded by
+                # the ring, only recorded while a tracer is attached
+                self.reqtrace.event(req.trace_id, "token", ts=now,
+                                    n=len(req.generated))
+        req.last_token_at = now
         if req.on_token is not None:
             try:
                 req.on_token(req, tok)
@@ -466,6 +570,14 @@ class Scheduler:
             self.engine.reset_slot(req.slot)
             req.slot = None
         self._c_finished.inc()
+        if self.reqtrace is not None:
+            if req.first_token_at is not None:
+                self.reqtrace.span(req.trace_id, "decode",
+                                   req.first_token_at, req.finished_at,
+                                   tokens=len(req.generated))
+            self.reqtrace.event(req.trace_id, "finish",
+                                ts=req.finished_at,
+                                generated=len(req.generated))
         req.done.set()
 
     def metrics(self) -> dict:
@@ -499,6 +611,14 @@ class Scheduler:
         self._sync_prefix_counters()
         m["prefill_chunks"] = self._c_prefill_chunks.value()
         m["requests_cancelled"] = self._c_cancelled.value()
+        # per-request latency rollups (NaN while no sample exists):
+        # bucket-estimated percentiles through the SHARED helper
+        m["queue_wait_count"] = self._h_queue_wait.cell_count()
+        m["queue_wait_sec_p50"] = self._h_queue_wait.percentile(50)
+        m["queue_wait_sec_p95"] = self._h_queue_wait.percentile(95)
+        m["tbt_count"] = self._h_tbt.cell_count()
+        m["tbt_sec_p50"] = self._h_tbt.percentile(50)
+        m["tbt_sec_p95"] = self._h_tbt.percentile(95)
         ps = getattr(self.engine, "pool_stats", None)
         if callable(ps):
             m.update(ps())

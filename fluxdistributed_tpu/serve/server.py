@@ -24,7 +24,15 @@ Routes:
   message.
 * ``GET /healthz`` — liveness + slot/queue occupancy.
 * ``GET /metrics`` — Prometheus text: queue depth, active slots,
-  prefill/decode tokens-per-sec, time-to-first-token, compile counts.
+  prefill/decode tokens-per-sec, time-to-first-token + queue-wait +
+  inter-token (TBT) histograms, compile counts.
+* ``GET /trace`` — the request-scoped Perfetto timeline
+  (``obs.reqtrace``; 404 when the scheduler has no tracer attached).
+
+Request ids: a client ``X-Request-Id`` header becomes the request's
+trace id — every reqtrace event and the response's ``request_id`` field
+carry it, so a router can stitch its own logs to this replica's
+timeline.
 """
 
 from __future__ import annotations
@@ -258,6 +266,16 @@ class LMServer:
                 elif self.path == "/metrics":
                     self._send(200, outer.metrics_text().encode(),
                                "text/plain; version=0.0.4")
+                elif self.path == "/trace":
+                    rt = outer.scheduler.reqtrace
+                    if rt is None:
+                        self._send_json(404, {
+                            "error": "request tracing is not enabled — "
+                                     "attach an obs.RequestTracer to the "
+                                     "scheduler (bin/serve.py "
+                                     "--trace-requests)"})
+                    else:
+                        self._send_json(200, rt.trace_document())
                 else:
                     self._send_json(404, {"error": "not found"})
 
@@ -271,6 +289,11 @@ class LMServer:
                     if not isinstance(body, dict):
                         raise ValueError("body must be a JSON object")
                     req = outer._parse_request(body)
+                    rid = self.headers.get("X-Request-Id")
+                    if rid:
+                        # the caller's correlation id becomes the trace
+                        # id every downstream event carries
+                        req.rid = str(rid)[:128]
                 except (ValueError, TypeError, json.JSONDecodeError) as e:
                     # TypeError covers type-malformed fields (e.g.
                     # prompt_tokens: 5) — still the client's 400, not a 500
@@ -306,12 +329,16 @@ class LMServer:
             def _result(self, req) -> dict:
                 out = {
                     "id": req.id,
+                    "request_id": req.trace_id,
                     "tokens": req.tokens,
                     "generated": list(req.generated),
                 }
                 text = outer._decode_text(req.tokens)
                 if text is not None:
                     out["text"] = text
+                if req.admitted_at and req.submitted_at:
+                    out["queue_wait_ms"] = round(
+                        (req.admitted_at - req.submitted_at) * 1e3, 2)
                 if req.first_token_at and req.submitted_at:
                     out["ttft_ms"] = round(
                         (req.first_token_at - req.submitted_at) * 1e3, 2)
@@ -320,6 +347,8 @@ class LMServer:
                     if dt > 0 and len(req.generated) > 1:
                         out["decode_tokens_per_sec"] = round(
                             (len(req.generated) - 1) / dt, 2)
+                        out["tbt_ms_avg"] = round(
+                            dt / (len(req.generated) - 1) * 1e3, 2)
                 return out
 
             def _blocking(self, req):

@@ -1012,7 +1012,10 @@ class _PhaseClock:
     and the offline Chrome/Perfetto timeline."""
 
     def __init__(self, observation: Observation):
+        from ..obs.spans import phase_scope
+
         self.tracer = observation.tracer
+        self._phase_scope = phase_scope
         self.hist = observation.registry.histogram(
             "fdtpu_train_phase_seconds",
             "wall seconds per train-step phase "
@@ -1022,9 +1025,12 @@ class _PhaseClock:
 
     @contextlib.contextmanager
     def __call__(self, name: str, **args):
+        # a real span registers itself as the active phase; the
+        # metrics-only path uses the lightweight registration alone so
+        # the stall watchdog can still name WHERE the loop wedged
         span = (
             self.tracer.span(name, **args) if self.tracer is not None
-            else contextlib.nullcontext()
+            else self._phase_scope(name)
         )
         t0 = time.perf_counter()
         try:
@@ -1143,6 +1149,7 @@ def train(
 
     it = iter(task.loader)
     _end = object()
+    last_batch = None  # the profile artifact prices the step at these shapes
     start_item = int(getattr(task.loader, "start", 0))
     j = start_item
     t_mark, j_mark = t_start, start_item
@@ -1228,6 +1235,7 @@ def train(
                 batch = next(it, _end)
             if batch is _end:
                 break
+            last_batch = batch
             if print_every and j % print_every == 0:
                 now = time.perf_counter()
                 if j > j_mark:
@@ -1358,6 +1366,22 @@ def train(
             # is exactly what the postmortem needs
             n = obs.tracer.export_chrome_trace(obs.trace_path)
             logger.info(f"span trace ({n} events) written to {obs.trace_path}")
+        if obs.profile_path:
+            # the planner-facing artifact: static per-layer/step costs
+            # at this run's real shapes + the measured phase histograms.
+            # Best-effort on purpose — a finished (or crashed) training
+            # run must never be failed retroactively by its profiler
+            from ..obs import profile as profile_lib
+
+            try:
+                prof = profile_lib.collect_profile(
+                    task, registry=reg, batch=last_batch,
+                    meta={"steps": done_steps, "steps_per_call": spc})
+                prof.save(obs.profile_path)
+                logger.info(f"cost profile written to {obs.profile_path}")
+            except Exception as e:  # noqa: BLE001
+                logger.info(f"cost profile collection failed: "
+                            f"{type(e).__name__}: {e}")
         if sink is not None:
             sink.write(step=j * spc, final=True)
 

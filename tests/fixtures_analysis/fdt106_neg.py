@@ -1,5 +1,7 @@
 """FDT106 negative: convention-conforming (or out-of-scope) names."""
 
+METRIC_PREFIX = "fdtpu_serve_"
+
 
 def _suffix():
     return "fdtpu_dynamic_total"
@@ -10,3 +12,27 @@ def register(reg):
     reg.gauge("fdtpu_queue_depth")
     reg.histogram("fdtpu_train_step_seconds")
     reg.counter(_suffix())  # non-literal first arg: out of scope
+    reg.counter(METRIC_PREFIX + "prefill_tokens")  # resolved, conforming
+    reg.gauge(f"{METRIC_PREFIX}active_slots")  # f-string, conforming
+
+
+def register_aliased(reg):
+    r, p = reg, METRIC_PREFIX
+    r.counter(p + "decode_tokens")  # alias chain resolves, conforming
+    for stem in ("queue_wait", "tbt"):  # loop target: dynamic, skipped
+        r.gauge(p + stem + "_p50")
+
+
+def register_param(reg, prefix):
+    # a function parameter never resolves — even if a module constant
+    # shares its name elsewhere, the arg poisons it
+    reg.counter(prefix + "whatever")
+
+
+REBOUND = "fdtpu_"
+REBOUND += "serve-"  # AugAssign poisons the name: stale value must not
+
+
+def register_rebound(reg):
+    # ...resolve here and mask the actually-bad registered name
+    reg.counter(REBOUND + "total")

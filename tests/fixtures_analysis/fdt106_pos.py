@@ -1,7 +1,16 @@
 """FDT106 positive: metric names off the fdtpu_* convention."""
 
+PREFIX = "serve_"  # resolves, but misses the fdtpu_ prefix
+
 
 def register(reg):
     reg.counter("serve_requests_total")  # missing prefix
     reg.gauge("Fdtpu_queue_depth")  # wrong case
     reg.histogram("fdtpu-step-seconds")  # dashes
+    reg.counter(PREFIX + "rejected_total")  # resolved concat, bad prefix
+    reg.gauge(f"{PREFIX}depth")  # resolved f-string, bad prefix
+
+
+def register_aliased(reg):
+    r, p = reg, PREFIX  # the scheduler's tuple-unpack prefix idiom
+    r.counter(p + "finished_total")  # resolves through the alias chain

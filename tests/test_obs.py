@@ -290,6 +290,53 @@ def test_watchdog_unarmed_during_warmup():
     assert w.poll() is False  # never fires before the warmup beats
 
 
+def test_watchdog_stall_names_innermost_active_phase(capsys):
+    """A stall episode must say WHERE the loop wedged: the warning names
+    the innermost active span/phase (registered cross-thread — the
+    watchdog polls from its own thread) and last_where keeps it for
+    callbacks."""
+    from fluxdistributed_tpu.obs.spans import innermost_active, phase_scope
+
+    r = Registry()
+    w = StepWatchdog(factor=2.0, min_interval=0.01, warmup=2, registry=r)
+    for _ in range(5):
+        w.beat()
+        time.sleep(0.005)
+    entered, release = threading.Event(), threading.Event()
+
+    def wedged_loop():  # the "hung dispatch" on the loop's own thread
+        with phase_scope("dispatch"):
+            entered.set()
+            release.wait(5)
+
+    t = threading.Thread(target=wedged_loop)
+    t.start()
+    try:
+        assert entered.wait(2)
+        assert innermost_active() == "dispatch"
+        time.sleep(0.06)  # well past factor x median
+        assert w.poll() is True
+        assert w.last_where == "dispatch"
+        err = capsys.readouterr().err
+        assert "STALL" in err and "'dispatch'" in err
+    finally:
+        release.set()
+        t.join()
+    assert innermost_active() is None  # registry cleaned up on exit
+
+
+def test_span_tracer_registers_active_span():
+    from fluxdistributed_tpu.obs.spans import innermost_active
+
+    t = SpanTracer()
+    assert innermost_active() is None
+    with t.span("step"):
+        with t.span("h2d"):
+            assert innermost_active() == "h2d"
+        assert innermost_active() == "step"
+    assert innermost_active() is None
+
+
 def test_watchdog_thread_and_oom_fold_in():
     r = Registry()
     fired = threading.Event()

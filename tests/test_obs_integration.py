@@ -45,8 +45,10 @@ def _task(mesh, cycles=6):
 def test_train_smoke_populates_registry_and_trace(mesh, tmp_path):
     reg = get_registry()
     trace_path = str(tmp_path / "run.trace.json")
+    profile_path = str(tmp_path / "run.profile.json")
     obs = Observation.full(trace_path=trace_path,
-                           jsonl_path=str(tmp_path / "run.jsonl"))
+                           jsonl_path=str(tmp_path / "run.jsonl"),
+                           profile_path=profile_path)
     steps_before = reg.value("fdtpu_train_steps_total")
     stalls_before = reg.value("fdtpu_watchdog_stalls_total")
 
@@ -79,6 +81,15 @@ def test_train_smoke_populates_registry_and_trace(mesh, tmp_path):
              for l in (tmp_path / "run.jsonl").read_text().splitlines()]
     assert lines and lines[-1]["final"]
     assert lines[-1]["metrics"]["fdtpu_train_steps_total"] >= 6
+
+    # the cost-profile artifact: versioned, topology-verified, with the
+    # REAL step's static price and this run's measured phases inside
+    from fluxdistributed_tpu.obs import Profile
+
+    prof = Profile.load(profile_path).verify(mesh)
+    assert prof.static["step"]["flops"] > 0
+    assert prof.measured["phases"]["dispatch"]["count"] >= 6
+    assert prof.meta["model"] == "SimpleCNN" and prof.meta["steps"] == 6
 
 
 def test_train_metrics_scrapeable_over_http(mesh):

@@ -1,0 +1,235 @@
+"""Cost-profile artifacts (obs.profile) + the shared percentile helper.
+
+Fast tier throughout: static costs come from LOWERED (never compiled)
+programs, the artifact round-trip is pure JSON, and the bubble-report
+math runs on synthetic profiles.  The end-to-end trainer → artifact
+path rides the existing obs-integration smoke run
+(tests/test_obs_integration.py) so no extra compile is paid here.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import pytest
+
+from fluxdistributed_tpu import mesh as mesh_lib
+from fluxdistributed_tpu.obs import Registry, bucket_percentile
+from fluxdistributed_tpu.obs.profile import (
+    Profile,
+    ProfileMismatch,
+    bubble_report,
+    collect_profile,
+    lm_layer_costs,
+    measured_from_registry,
+    modeled_bubble,
+    stage_costs_from_static,
+    step_cost,
+)
+
+
+# ---------------------------------------------------------------------------
+# bucket_percentile: the ONE shared percentile implementation
+# ---------------------------------------------------------------------------
+
+def test_bucket_percentile_interpolates():
+    bounds = (0.1, 1.0, 10.0)
+    counts = [10, 10, 0, 0]  # 10 in (0,0.1], 10 in (0.1,1], none beyond
+    assert bucket_percentile(bounds, counts, 50) == pytest.approx(0.1)
+    # p75 = rank 15 -> halfway through the (0.1, 1] bucket
+    assert bucket_percentile(bounds, counts, 75) == pytest.approx(0.55)
+    assert bucket_percentile(bounds, counts, 100) == pytest.approx(1.0)
+
+
+def test_bucket_percentile_edge_cases():
+    bounds = (1.0, 2.0)
+    assert math.isnan(bucket_percentile(bounds, [0, 0, 0], 50))  # empty
+    # all mass in +Inf: the honest answer is the largest finite bound
+    assert bucket_percentile(bounds, [0, 0, 5], 99) == 2.0
+    with pytest.raises(ValueError, match="percentile"):
+        bucket_percentile(bounds, [1, 0, 0], 150)
+    with pytest.raises(ValueError, match="counts"):
+        bucket_percentile(bounds, [1, 0], 50)  # missing +Inf entry
+
+
+def test_histogram_percentile_and_series():
+    r = Registry()
+    h = r.histogram("p_seconds", "", buckets=(0.1, 1.0))
+    assert math.isnan(h.percentile(50))  # empty reads NaN, not 0
+    for v in (0.05, 0.5, 0.6, 99.0):
+        h.observe(v)
+    assert 0 < h.percentile(50) <= 1.0
+    cell = h.series()[()]
+    assert cell["count"] == 4 and cell["sum"] == pytest.approx(100.15)
+    assert cell["bounds"] == [0.1, 1.0] and sum(cell["counts"]) == 4
+
+
+# ---------------------------------------------------------------------------
+# Profile artifact: round-trip + topology gate
+# ---------------------------------------------------------------------------
+
+def _tiny_profile(mesh=None, **measured):
+    from fluxdistributed_tpu.compilation import topology_fingerprint
+    from fluxdistributed_tpu.obs.profile import describe_topology
+
+    return Profile(fingerprint=topology_fingerprint(mesh=mesh),
+                   topology=describe_topology(mesh),
+                   static={"model": None, "step": None, "variants": {}},
+                   measured=dict(measured), meta={"producer": "test"})
+
+
+def test_profile_save_load_round_trip(tmp_path):
+    mesh = mesh_lib.data_mesh(8)
+    prof = _tiny_profile(mesh, phases={"dispatch": {"sum": 1.0,
+                                                    "count": 4}})
+    path = str(tmp_path / "p.json")
+    prof.save(path)
+    # on-disk: strict JSON with the documented schema tag
+    doc = json.loads(open(path).read())
+    assert doc["schema"] == "fdtpu-profile/v1"
+    assert doc["created_unix"] > 0
+    back = Profile.load(path)
+    assert back.fingerprint == prof.fingerprint
+    assert back.measured == prof.measured
+    assert back.topology["device_count"] == 8
+    # same-topology verify passes and chains
+    assert back.verify(mesh) is back
+
+
+def test_profile_load_rejects_wrong_schema(tmp_path):
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps({"schema": "something/v9"}))
+    with pytest.raises(ValueError, match="fdtpu-profile/v1"):
+        Profile.load(str(path))
+
+
+def test_profile_verify_rejects_topology_mismatch(tmp_path):
+    mesh = mesh_lib.data_mesh(8)
+    prof = _tiny_profile(mesh)
+    path = str(tmp_path / "p.json")
+    prof.save(path)
+    # a tampered/foreign fingerprint must be rejected with BOTH
+    # topologies named in the error
+    doc = json.loads(open(path).read())
+    doc["fingerprint"] = "0" * 16
+    open(path, "w").write(json.dumps(doc))
+    with pytest.raises(ProfileMismatch, match="do not transfer"):
+        Profile.load(path).verify(mesh)
+    # and a mesh-shape change alone flips the fingerprint too
+    with pytest.raises(ProfileMismatch):
+        _tiny_profile(mesh).verify(mesh_lib.data_mesh(4))
+
+
+# ---------------------------------------------------------------------------
+# static costs: staged-out model + real prepared step (lower-only)
+# ---------------------------------------------------------------------------
+
+def test_lm_layer_costs_depth_difference():
+    from fluxdistributed_tpu.models import lm_tiny
+
+    model = lm_tiny(vocab=64, depth=4, dim=32, num_heads=2, mlp_dim=64)
+    costs = lm_layer_costs(model, batch_size=2, seqlen=16)
+    assert costs["depth"] == 4
+    for part in ("block", "outer", "total"):
+        assert costs[part]["flops"] > 0
+        assert costs[part]["bytes"] > 0
+    # affine-in-depth consistency: total = outer + depth * block
+    assert costs["total"]["flops"] == pytest.approx(
+        costs["outer"]["flops"] + 4 * costs["block"]["flops"])
+    # one decoder block dominates the tiny outer at seqlen 16? not
+    # necessarily (vocab head) — but both must be finite and the block
+    # cost must scale with nothing hidden: pricing again is identical
+    assert lm_layer_costs(model, 2, 16)["block"] == costs["block"]
+
+
+def test_step_cost_prices_prepared_step_and_collect_profile():
+    from fluxdistributed_tpu import optim
+    from fluxdistributed_tpu.data import SyntheticDataset
+    from fluxdistributed_tpu.models import SimpleCNN
+    from fluxdistributed_tpu.train import prepare_training
+    from fluxdistributed_tpu.train.trainer import _dummy_batch
+
+    mesh = mesh_lib.data_mesh(8)
+    ds = SyntheticDataset(nsamples=32, nclasses=4, shape=(8, 8, 3))
+    task = prepare_training(SimpleCNN(num_classes=4), ds,
+                            optim.adam(1e-3), mesh=mesh, batch_size=16,
+                            cycles=1)
+    batch = _dummy_batch(ds, None, 16, mesh, 1, seed=0)
+    cost = step_cost(task.step_fn, (task.state, batch))
+    assert cost is not None and cost["flops"] > 0
+
+    reg = Registry()
+    reg.histogram("fdtpu_train_phase_seconds", "", labelnames=("phase",)
+                  ).labels(phase="dispatch").observe(0.25)
+    reg.counter("fdtpu_train_steps_total", "").inc(3)
+    prof = collect_profile(task, registry=reg, batch=batch)
+    assert prof.static["step"]["flops"] == cost["flops"]
+    assert prof.measured["phases"]["dispatch"]["count"] == 1
+    assert prof.measured["counters"]["fdtpu_train_steps_total"] == 3
+    assert prof.meta["model"] == "SimpleCNN"
+    prof.verify(mesh)  # recorded on THIS topology
+
+
+def test_step_cost_degrades_to_none_on_unlowerable():
+    assert step_cost(lambda a: a, (1,)) is None  # no .lower
+
+
+def test_measured_from_registry_skips_empty():
+    reg = Registry()
+    reg.histogram("fdtpu_train_phase_seconds", "", labelnames=("phase",))
+    out = measured_from_registry(reg)
+    assert "phases" not in out or out["phases"] == {}
+
+
+# ---------------------------------------------------------------------------
+# modeled vs measured bubble accounting
+# ---------------------------------------------------------------------------
+
+def test_modeled_bubble_reduces_to_classic_formula():
+    for S, M in ((4, 4), (4, 8), (8, 16)):
+        assert modeled_bubble([1.0] * S, M) == pytest.approx(
+            (S - 1) / (M + S - 1))
+    # an imbalanced stage worsens the bubble beyond the uniform formula
+    assert modeled_bubble([1.0, 1.0, 1.0, 2.0], 8) > (4 - 1) / (8 + 4 - 1)
+    # degenerate inputs take the documented 0.0 fallback, never raise
+    assert modeled_bubble([], 8) == 0.0
+    assert modeled_bubble([0.0, 0.0], 8) == 0.0
+
+
+def test_stage_costs_split_blocks_and_outer():
+    model_costs = {"depth": 8, "block": {"flops": 10.0},
+                   "outer": {"flops": 4.0}}
+    stages = stage_costs_from_static(model_costs, 4)
+    assert len(stages) == 4
+    assert sum(stages) == pytest.approx(8 * 10.0 + 4.0)
+    assert stages[0] == pytest.approx(2 * 10.0 + 2.0)  # outer/2 first
+    assert stages[-1] == pytest.approx(2 * 10.0 + 2.0)  # outer/2 last
+    # remainder blocks land on the leading stages
+    stages = stage_costs_from_static(model_costs, 3)
+    assert [round(s - (2.0 if i in (0, 2) else 0), 1)
+            for i, s in enumerate(stages)] == [30.0, 30.0, 20.0]
+
+
+def test_bubble_report_recovers_planted_bubble():
+    """Rows manufactured from the schedule model itself must round-trip:
+    t(M) = (M + S - 1) * tau  =>  measured == modeled == classic."""
+    S, tau = 4, 2.0
+    rows = [{"M": M, "S": S, "step_ms": (M + S - 1) * tau}
+            for M in (4, 8, 16, 32)]
+    prof = Profile(fingerprint="x", measured={"pp_rows": rows},
+                   static={"model": None})
+    rep = bubble_report(prof)
+    for r in rep:
+        classic = (S - 1) / (r["M"] + S - 1)
+        assert r["measured_bubble"] == pytest.approx(classic, abs=1e-3)
+        assert r["modeled_bubble"] == pytest.approx(classic, abs=1e-3)
+        assert r["fit_ms_per_microbatch"] == pytest.approx(tau)
+        assert r["fit_fixed_ms"] == pytest.approx((S - 1) * tau)
+
+
+def test_bubble_report_needs_two_rows():
+    prof = Profile(fingerprint="x",
+                   measured={"pp_rows": [{"M": 4, "S": 4, "step_ms": 1}]})
+    with pytest.raises(ValueError, match=">= 2"):
+        bubble_report(prof)

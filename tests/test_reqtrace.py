@@ -1,0 +1,331 @@
+"""Request-scoped tracing (obs.reqtrace) through the serve stack.
+
+All fast tier, FakeEngine-driven (no compiles): lifecycle events land
+on per-request Perfetto tracks, the queue-wait and inter-token (TBT)
+histograms populate next to the pinned TTFT one, the exported trace
+JSON is well-formed (ph/ts/pid/tid, request track metadata, span
+nesting), the ring stays bounded, and the HTTP layer propagates
+``X-Request-Id`` end-to-end and serves ``GET /trace``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from fluxdistributed_tpu.obs import RequestTracer
+from fluxdistributed_tpu.serve import Request, Scheduler
+from fluxdistributed_tpu.serve.server import LMServer
+
+
+class FakeEngine:
+    """Whole-prefill pure-python engine (the test_obs pattern)."""
+
+    max_slots = 2
+
+    def validate_request(self, prompt_len, max_new_tokens):
+        pass
+
+    def prefill(self, slot, prompt, temperature, key):
+        return 7, 8
+
+    def step_decode(self):
+        return [1] * self.max_slots
+
+    def reset_slot(self, slot):
+        pass
+
+    def compile_stats(self):
+        return {"decode_compiles": 1, "prefill_compiles": 1,
+                "insert_compiles": 1}
+
+
+class FakeChunkEngine(FakeEngine):
+    """Incremental engine: 4-token chunks — exercises the chunked
+    prefill events and the rid riding the engine's prefill state."""
+
+    prefill_incremental = True
+    prefill_chunk = 4
+
+    def __init__(self):
+        self.begun = []  # (slot, rid) — the propagation evidence
+
+    def can_admit(self, prompt, max_new_tokens):
+        return True
+
+    def prefill_begin(self, slot, tokens, temperature, key,
+                      max_new_tokens=None, rid=None):
+        self.begun.append((slot, rid))
+        return {"slot": slot, "pos": 0, "plen": len(tokens)}
+
+    def prefill_step(self, st):
+        n = min(self.prefill_chunk, st["plen"] - st["pos"])
+        st["pos"] += n
+        done = st["pos"] >= st["plen"]
+        return (7 if done else None), n, self.prefill_chunk
+
+
+def _drain(sched, reqs):
+    for r in reqs:
+        sched.submit(r)
+    sched.run_until_idle()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle events + latency histograms
+# ---------------------------------------------------------------------------
+
+def test_lifecycle_events_and_latency_histograms():
+    rt = RequestTracer()
+    sched = Scheduler(FakeEngine(), max_queue=8, reqtrace=rt)
+    reqs = [Request(prompt=[1, 2, 3], max_new_tokens=3),
+            Request(prompt=[4], max_new_tokens=3)]
+    _drain(sched, reqs)
+
+    names = [e["name"] for e in rt.trace_events()]
+    for needed in ("enqueue", "queue_wait", "prefill", "first_token",
+                   "token", "decode", "finish", "decode_step"):
+        assert needed in names, f"{needed} missing from {set(names)}"
+
+    # queue-wait: one sample per admitted request; TBT: every token
+    # after each request's first (3 generated => 2 gaps each)
+    assert sched.registry.get(
+        "fdtpu_serve_queue_wait_seconds").cell_count() == 2
+    assert sched.registry.get("fdtpu_serve_tbt_seconds").cell_count() == 4
+    m = sched.metrics()
+    assert m["queue_wait_count"] == 2 and m["tbt_count"] == 4
+    assert m["queue_wait_sec_p50"] >= 0 and m["tbt_sec_p50"] >= 0
+    # /metrics exposes all three latency histograms + the p rollups
+    text = sched.registry.prometheus_text()
+    for series in ("fdtpu_serve_ttft_seconds_bucket",
+                   "fdtpu_serve_queue_wait_seconds_bucket",
+                   "fdtpu_serve_tbt_seconds_bucket",
+                   "fdtpu_serve_queue_wait_sec_p50",
+                   "fdtpu_serve_tbt_sec_p95",
+                   "fdtpu_serve_ttft_hist_sec_p50"):
+        assert series in text, series
+
+
+def test_histograms_populate_without_tracer():
+    """Queue-wait/TBT are first-class metrics — they must not depend on
+    a tracer being attached."""
+    sched = Scheduler(FakeEngine(), max_queue=8)
+    _drain(sched, [Request(prompt=[1], max_new_tokens=4)])
+    assert sched.reqtrace is None
+    assert sched.registry.get(
+        "fdtpu_serve_queue_wait_seconds").cell_count() == 1
+    assert sched.registry.get("fdtpu_serve_tbt_seconds").cell_count() == 3
+    # request-side stamps exist for the HTTP result fields
+    # (admitted_at between submitted_at and first_token_at)
+
+
+def test_request_timing_fields_ordered():
+    sched = Scheduler(FakeEngine(), max_queue=8)
+    req = Request(prompt=[1, 2], max_new_tokens=2)
+    _drain(sched, [req])
+    assert req.submitted_at <= req.admitted_at <= req.first_token_at
+    assert req.last_token_at is not None
+    assert req.first_token_at <= req.last_token_at <= req.finished_at
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export: well-formed JSON, request tracks, nesting
+# ---------------------------------------------------------------------------
+
+def test_perfetto_export_well_formed(tmp_path):
+    rt = RequestTracer()
+    sched = Scheduler(FakeEngine(), max_queue=8, reqtrace=rt)
+    a = Request(prompt=[1, 2], max_new_tokens=2, rid="req-A")
+    b = Request(prompt=[3], max_new_tokens=2)
+    _drain(sched, [a, b])
+
+    path = tmp_path / "req.trace.json"
+    n = rt.export_chrome_trace(str(path))
+    doc = json.loads(path.read_text())  # parses
+    evs = doc["traceEvents"]
+    assert n == len([e for e in evs if e["ph"] not in ("M",)])
+
+    by_ph: dict = {}
+    for e in evs:
+        by_ph.setdefault(e["ph"], []).append(e)
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        if e["ph"] != "M":
+            assert isinstance(e["ts"], float)
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+    assert set(by_ph) == {"M", "X", "i"}
+
+    # per-request tracks: metadata names a lane per trace id, and the
+    # explicit rid wins over the numeric fallback
+    lanes = {e["args"]["name"]: e["tid"]
+             for e in by_ph["M"] if e["name"] == "thread_name"}
+    assert "request req-A" in lanes
+    assert f"request {b.id}" in lanes
+    assert "scheduler" in lanes  # decode ticks ride their own lane
+
+    # nesting/order on request A's lane: queue_wait ends before the
+    # prefill span begins, and the decode span covers its tokens
+    tid = lanes["request req-A"]
+    mine = [e for e in evs if e.get("tid") == tid and e["ph"] != "M"]
+    spans = {e["name"]: e for e in mine if e["ph"] == "X"}
+    qw, pf, dec = spans["queue_wait"], spans["prefill"], spans["decode"]
+    assert qw["ts"] + qw["dur"] <= pf["ts"] + 1e-3
+    toks = [e for e in mine if e["name"] == "token"]
+    for t in toks:
+        assert dec["ts"] - 1e-3 <= t["ts"] <= dec["ts"] + dec["dur"] + 1e-3
+    assert doc["otherData"]["dropped_events"] == 0
+
+
+def test_ring_bounds_memory_and_counts_drops():
+    rt = RequestTracer(max_events=8)
+    sched = Scheduler(FakeEngine(), max_queue=16, reqtrace=rt)
+    _drain(sched, [Request(prompt=[1], max_new_tokens=4)
+                   for _ in range(4)])
+    assert len(rt) == 8
+    assert rt.dropped > 0
+    # the drop count is exported so a truncated timeline says so
+    assert rt.trace_document()["otherData"]["dropped_events"] == rt.dropped
+
+
+def test_lane_map_bounded_and_tids_never_reused():
+    """A days-long server sees millions of request ids: the lane map
+    must stay bounded like the ring, and an evicted lane's tid must
+    never be handed to a different request (old ring events keep their
+    number)."""
+    rt = RequestTracer(max_events=16, max_lanes=3)
+    for i in range(10):
+        rt.event(f"r{i}", "enqueue")
+    assert len(rt._tids) == 3
+    assert rt.lanes_evicted == 7
+    doc = rt.trace_document()
+    assert doc["otherData"]["evicted_lanes"] == 7
+    # monotonic tids: the surviving lanes are the NEWEST three
+    lanes = {e["args"]["name"]: e["tid"] for e in doc["traceEvents"]
+             if e["name"] == "thread_name"}
+    assert set(lanes) == {"request r7", "request r8", "request r9"}
+    assert sorted(lanes.values()) == [8, 9, 10]
+
+
+def test_lane_eviction_is_lru_hot_lanes_survive():
+    """Eviction must be least-recently-USED, not first-inserted: the
+    scheduler lane (among the FIRST inserted, touched every tick) and a
+    long-running stream must keep one track — and its tid — through a
+    flood of one-shot request ids."""
+    rt = RequestTracer(max_lanes=3)
+    rt.event("scheduler", "decode_step")
+    sched_tid = rt._tids["scheduler"]
+    for i in range(20):
+        rt.event(f"one-shot-{i}", "enqueue")
+        rt.event("scheduler", "decode_step")  # hot lane refreshed
+    assert rt._tids["scheduler"] == sched_tid  # never evicted, one tid
+    names = [e["args"]["name"] for e in rt.trace_events()
+             if e["name"] == "thread_name"]
+    assert "scheduler" in names
+
+
+def test_queued_cancel_closes_track():
+    """A request cancelled BEFORE admission must still emit a terminal
+    event — an enqueue with no close reads as a lost request."""
+    rt = RequestTracer()
+    sched = Scheduler(FakeEngine(), max_queue=4, reqtrace=rt)
+    # fill both slots so a third request stays queued
+    a = Request(prompt=[1], max_new_tokens=50)
+    b = Request(prompt=[2], max_new_tokens=50)
+    queued = Request(prompt=[3], max_new_tokens=50, rid="queued-victim")
+    sched.submit(a)
+    sched.submit(b)
+    sched.step()  # admit a+b into the 2 slots
+    sched.submit(queued)
+    assert sched.cancel(queued) is True  # left the queue immediately
+    mine = [e["name"] for e in rt.trace_events()
+            if e["ph"] != "M" and e["tid"] == rt._tids["queued-victim"]]
+    assert mine == ["enqueue", "cancel"]
+    sched.cancel(a)
+    sched.cancel(b)
+    sched.step()
+
+
+def test_chunked_prefill_events_and_rid_propagation():
+    rt = RequestTracer()
+    eng = FakeChunkEngine()
+    sched = Scheduler(eng, max_queue=8, reqtrace=rt)
+    req = Request(prompt=list(range(10)), max_new_tokens=2, rid="chunky")
+    _drain(sched, [req])
+    # the trace id rode HTTP->Scheduler->LMEngine.prefill_begin
+    assert eng.begun == [(0, "chunky")]
+    chunk_spans = [e for e in rt.trace_events()
+                   if e["name"] == "prefill_chunk"]
+    assert len(chunk_spans) == 3  # 10 tokens / chunk 4
+    assert all(e["ph"] == "X" for e in chunk_spans)
+
+
+def test_cancel_and_drain_events():
+    rt = RequestTracer()
+    sched = Scheduler(FakeEngine(), max_queue=8, reqtrace=rt)
+    victim = Request(prompt=[1], max_new_tokens=50)
+    sched.submit(victim)
+    sched.step()  # admit + first token
+    sched.cancel(victim)
+    sched.step()  # teardown on the driver thread
+    sched.begin_drain()
+    names = [e["name"] for e in rt.trace_events()]
+    assert "cancel" in names and "drain_begin" in names
+    assert victim.state == "done"
+
+
+# ---------------------------------------------------------------------------
+# HTTP: X-Request-Id end-to-end + GET /trace
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def http_server():
+    rt = RequestTracer()
+    sched = Scheduler(FakeEngine(), max_queue=8, reqtrace=rt)
+    srv = LMServer(sched, vocab=256)
+    httpd = srv.serve("127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", rt
+    httpd.shutdown()
+    srv.close()
+
+
+def test_http_request_id_and_trace_endpoint(http_server):
+    base, rt = http_server
+    req = urllib.request.Request(
+        f"{base}/v1/generate",
+        data=json.dumps({"prompt_tokens": [1, 2], "max_tokens": 3}).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Request-Id": "router-7/a"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        out = json.loads(r.read())
+    assert out["request_id"] == "router-7/a"
+    assert out["queue_wait_ms"] >= 0
+    assert out["ttft_ms"] >= 0
+    assert out["tbt_ms_avg"] >= 0
+
+    with urllib.request.urlopen(f"{base}/trace", timeout=30) as r:
+        doc = json.loads(r.read())
+    lanes = [e["args"]["name"] for e in doc["traceEvents"]
+             if e["name"] == "thread_name"]
+    assert "request router-7/a" in lanes
+
+
+def test_http_trace_404_without_tracer():
+    sched = Scheduler(FakeEngine(), max_queue=4)  # no tracer attached
+    srv = LMServer(sched, vocab=256)
+    httpd = srv.serve("127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{httpd.server_address[1]}/trace",
+                timeout=30)
+        assert ei.value.code == 404
+        assert "trace-requests" in json.loads(ei.value.read())["error"]
+    finally:
+        httpd.shutdown()
+        srv.close()

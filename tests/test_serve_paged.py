@@ -128,9 +128,9 @@ class _FakeChunkEngine:
         return True
 
     def prefill_begin(self, slot, tokens, temperature, key,
-                      max_new_tokens=None):
+                      max_new_tokens=None, rid=None):
         self.admitted.append(slot)
-        return {"slot": slot, "pos": 0, "plen": len(tokens)}
+        return {"slot": slot, "pos": 0, "plen": len(tokens), "rid": rid}
 
     def prefill_step(self, st):
         n = min(self.prefill_chunk, st["plen"] - st["pos"])
