@@ -1,0 +1,382 @@
+#!/usr/bin/env python
+"""N-replica serving router — the fault-tolerant front process over
+``bin/serve.py --lm`` replicas (``fluxdistributed_tpu.serve.router``).
+
+Front an existing fleet::
+
+    python bin/router.py --replica http://127.0.0.1:8001 \
+        --replica http://127.0.0.1:8002 --port 8100
+
+Supervise one (spawn the replicas yourself, restartable)::
+
+    python bin/router.py --spawn 2 --port 8100 \
+        --replica-cmd "python bin/serve.py --lm --model lm_tiny \
+                       --prewarm --aot-dir aot/ --port 0"
+
+Requests to ``POST /v1/generate`` route to the least-loaded healthy
+replica (queue-wait p50 truth off each replica's /metrics) and fail
+over transparently when a replica dies before its first token; a
+client ``X-Request-Id`` rides every hop.  ``GET /healthz`` /
+``/metrics`` / ``/trace`` roll the fleet up (replica-labeled series,
+stitched Perfetto timelines).
+
+Zero-downtime redeploy of a supervised fleet (one replica at a time:
+drain → SIGTERM → respawn off the AOT pool → wait healthy)::
+
+    python bin/router.py --rolling-restart http://127.0.0.1:8100
+
+``--smoke`` runs the self-contained 2-replica failover demo CI uses:
+fake-engine replicas, one killed mid-burst by a deterministic fault
+plan, zero failed requests asserted, breaker transitions checked, and
+the stitched trace written out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import sys
+import threading
+import time
+
+
+def _bootstrap() -> None:
+    """Make the package importable when run as ``python bin/router.py``
+    from a checkout (no install, no PYTHONPATH) — the bin/lint.py
+    pattern."""
+    try:
+        import fluxdistributed_tpu  # noqa: F401
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+
+
+_bootstrap()
+
+
+def _replica_env() -> dict:
+    """Env for spawned replica children: they must import the package
+    from the same place this process did."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return {"PYTHONPATH": os.pathsep.join(
+        x for x in (root, os.environ.get("PYTHONPATH")) if x)}
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--replica", action="append", default=[],
+                   metavar="URL", dest="replicas",
+                   help="replica base url (repeatable): front an "
+                        "existing fleet")
+    p.add_argument("--spawn", type=int, default=0, metavar="N",
+                   help="supervise N replica subprocesses spawned from "
+                        "--replica-cmd (--port 0 appended; the bound "
+                        "port is read from the child's "
+                        "FDTPU_SERVE_PORT= line) — enables rolling "
+                        "restarts")
+    p.add_argument("--replica-cmd", default=None, metavar="CMD",
+                   help="command line for --spawn replicas, e.g. "
+                        "\"python bin/serve.py --lm --model lm_tiny "
+                        "--prewarm --aot-dir aot/\"")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8100,
+                   help="router port (0 = ephemeral, announced as "
+                        "FDTPU_ROUTER_PORT=<n> on stdout)")
+    p.add_argument("--probe-interval", type=float, default=0.5,
+                   help="seconds between /healthz probe sweeps")
+    p.add_argument("--probe-timeout", type=float, default=2.0)
+    p.add_argument("--failure-threshold", type=int, default=3,
+                   help="consecutive probe/dispatch failures that open "
+                        "a replica's circuit breaker")
+    p.add_argument("--breaker-cooldown", type=float, default=2.0,
+                   help="seconds an open breaker waits before "
+                        "half-opening for a trial request")
+    p.add_argument("--dispatch-tries", type=int, default=3,
+                   help="dispatch attempts per request (failover "
+                        "budget, faults.with_retries semantics)")
+    p.add_argument("--upstream-timeout", type=float, default=600.0,
+                   help="socket timeout per upstream dispatch")
+    p.add_argument("--metrics-stale-after", type=float, default=3.0,
+                   help="seconds after which a replica's load scrape "
+                        "is stale and dispatch falls back to "
+                        "round-robin")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="per-replica in-flight drain bound during "
+                        "--rolling-restart")
+    p.add_argument("--fault-plan", default=None, metavar="JSON",
+                   help="router-side deterministic fault injection "
+                        "(sites serve.dispatch / serve.probe); JSON "
+                        "object or @file")
+    p.add_argument("--rolling-restart", default=None, metavar="ROUTER_URL",
+                   help="client mode: ask the running router at "
+                        "ROUTER_URL to rolling-restart its supervised "
+                        "fleet, print the result, exit")
+    p.add_argument("--smoke", action="store_true",
+                   help="run the self-contained 2-replica failover "
+                        "smoke (fake engines, deterministic mid-burst "
+                        "kill, rolling restart) and exit nonzero on "
+                        "any dropped request")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write the stitched fleet Perfetto trace here "
+                        "(smoke mode)")
+    return p
+
+
+def make_router(args):
+    """Build the Router (+ spawned SupervisedReplicas in --spawn mode).
+    Returns ``(router, supervisors)``."""
+    from fluxdistributed_tpu.serve.router import (Replica, Router,
+                                                  SupervisedReplica)
+
+    router = Router(
+        probe_interval=args.probe_interval,
+        probe_timeout=args.probe_timeout,
+        failure_threshold=args.failure_threshold,
+        breaker_cooldown=args.breaker_cooldown,
+        metrics_stale_after=args.metrics_stale_after,
+        dispatch_tries=args.dispatch_tries,
+        upstream_timeout=args.upstream_timeout,
+    )
+    sups = []
+    for i, url in enumerate(args.replicas):
+        router.add_replica(Replica(name=f"r{i}", url=url))
+    if args.spawn:
+        if not args.replica_cmd:
+            raise SystemExit("--spawn needs --replica-cmd")
+        base = len(args.replicas)
+        argv = shlex.split(args.replica_cmd)
+        for i in range(args.spawn):
+            name = f"r{base + i}"
+            sup = SupervisedReplica(argv, name=name, env=_replica_env())
+            url = sup.spawn()
+            sups.append(sup)
+            router.add_replica(Replica(name=name, url=url,
+                                       restart=sup.restart))
+    if not router.replicas:
+        raise SystemExit("no replicas: pass --replica URL and/or --spawn N")
+    return router, sups
+
+
+def rolling_restart_client(url: str, drain_timeout: float) -> int:
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url.rstrip("/") + "/admin/rolling_restart",
+        data=json.dumps({"drain_timeout": drain_timeout}).encode(),
+        method="POST", headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=600) as r:
+            body = json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        print(e.read().decode(), file=sys.stderr)
+        return 1
+    print(json.dumps(body, indent=2))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# smoke: the CI 2-replica failover demo
+# ---------------------------------------------------------------------------
+
+
+def run_smoke(args) -> int:
+    """2 fake-engine replica subprocesses; replica r0 carries a fault
+    plan that hard-kills it (``os._exit``) at scheduler tick 60 —
+    mid-burst.  A 32-request concurrent burst through the router must
+    complete with ZERO failures and byte-exact deterministic tokens
+    (failed-over requests re-generate identically on the survivor);
+    the dead replica's breaker must open, then recover through
+    half-open once it is brought back; a rolling restart under light
+    load must drop nothing.  The stitched /trace goes to --trace-out."""
+    import urllib.request
+
+    from fluxdistributed_tpu.serve.router import (Replica, Router,
+                                                  SupervisedReplica,
+                                                  wait_http_ready)
+    from fluxdistributed_tpu.serve.testing import fake_tokens
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    serve_py = os.path.join(here, "serve.py")
+    env = _replica_env()
+    kill_plan = json.dumps(
+        {"fail": [{"site": "serve.tick", "at": 60, "action": "exit"}]})
+
+    def replica_argv(extra):
+        return ([sys.executable, serve_py, "--lm", "--fake-engine",
+                 "--max-slots", "4", "--max-len", "256",
+                 "--max-queue", "64", "--fake-step-delay", "0.005",
+                 "--trace-requests", "/dev/null", "--port", "0"]
+                + extra)
+
+    sup0 = SupervisedReplica(replica_argv(["--fault-plan", kill_plan]),
+                             name="r0", env=env)
+    sup1 = SupervisedReplica(replica_argv([]), name="r1", env=env)
+    url0, url1 = sup0.spawn(), sup1.spawn()
+    wait_http_ready(url0 + "/healthz")
+    wait_http_ready(url1 + "/healthz")
+
+    router = Router(probe_interval=0.2, probe_timeout=2.0,
+                    failure_threshold=2, breaker_cooldown=0.5,
+                    dispatch_tries=4, upstream_timeout=60.0)
+    rep0 = router.add_replica(Replica("r0", url0, restart=sup0.restart))
+    router.add_replica(Replica("r1", url1, restart=sup1.restart))
+    httpd = router.serve("127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{router.bound_port}"
+    failures = []
+
+    def post(i, results):
+        prompt = [i % 7 + 1, i % 5 + 1, i % 3 + 1]
+        body = json.dumps({"prompt_tokens": prompt,
+                           "max_tokens": 24}).encode()
+        req = urllib.request.Request(
+            f"{base}/v1/generate", data=body, method="POST",
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": f"smoke-{i}"})
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                results[i] = (r.status, json.loads(r.read()))
+        except Exception as e:  # noqa: BLE001 — tallied below
+            results[i] = (None, f"{type(e).__name__}: {e}")
+
+    def burst(n, tag):
+        results = {}
+        threads = [threading.Thread(target=post, args=(i, results))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, (code, body) in sorted(results.items()):
+            if code != 200:
+                failures.append(f"{tag} request {i}: {code} {body}")
+                continue
+            if body.get("request_id") != f"smoke-{i}":
+                failures.append(
+                    f"{tag} request {i}: X-Request-Id not preserved "
+                    f"({body.get('request_id')!r})")
+            prompt = [i % 7 + 1, i % 5 + 1, i % 3 + 1]
+            want = fake_tokens(prompt, 24)
+            if body.get("generated") != want:
+                failures.append(
+                    f"{tag} request {i}: tokens diverged after "
+                    f"failover: {body.get('generated')} != {want}")
+        return results
+
+    print("smoke: mid-burst kill (r0 exits at tick 60)...")
+    burst(32, "kill-burst")
+    deadline = time.monotonic() + 10
+    while sup0.alive() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    if sup0.alive():
+        failures.append("fault plan did not kill r0")
+    router.probe_now()
+    opens = router.registry.value(
+        "fdtpu_router_breaker_opens_total", "r0")
+    if opens < 1:
+        failures.append(f"breaker for r0 never opened (opens={opens})")
+
+    print("smoke: r0 returns at its old port; breaker must recover...")
+    old_port = sup0.port
+    sup0.stop()  # reap the dead child
+    sup0.argv = replica_argv([])  # successor WITHOUT the kill plan
+    sup0.spawn(port=old_port)
+    wait_http_ready(url0 + "/healthz")
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        router.probe_now()
+        if rep0.breaker == "closed" and rep0.healthy:
+            break
+        time.sleep(0.1)
+    if rep0.breaker != "closed":
+        failures.append(
+            f"breaker for r0 did not re-close (state={rep0.breaker})")
+
+    print("smoke: rolling restart under light load...")
+    stop_load = threading.Event()
+    load_results = {}
+
+    def light_load():
+        i = 1000
+        while not stop_load.is_set():
+            post(i, load_results)
+            i += 1
+            time.sleep(0.05)
+
+    load_thread = threading.Thread(target=light_load, daemon=True)
+    load_thread.start()
+    try:
+        restarted = router.rolling_restart(drain_timeout=20.0,
+                                           ready_timeout=60.0)
+    finally:
+        stop_load.set()
+        load_thread.join(timeout=10)
+    for i, (code, body) in sorted(load_results.items()):
+        if code != 200:
+            failures.append(
+                f"rolling-restart load request {i}: {code} {body}")
+    if len(restarted) != 2:
+        failures.append(f"rolling restart covered {len(restarted)}/2")
+
+    burst(8, "post-restart")
+    doc = router.trace_document()
+    pids = {e.get("pid") for e in doc["traceEvents"]}
+    if len(pids) < 2:
+        failures.append(f"stitched trace has {len(pids)} replica rows")
+    if args.trace_out:
+        with open(args.trace_out, "w") as f:
+            json.dump(doc, f)
+        print(f"stitched trace ({len(doc['traceEvents'])} events, "
+              f"{len(pids)} replica rows) written to {args.trace_out}")
+
+    httpd.shutdown()
+    router.close()
+    for sup in (sup0, sup1):
+        sup.stop()
+    if failures:
+        print("SMOKE FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("smoke OK: 40 routed requests, 0 failures, breaker opened "
+          "on the kill and recovered, rolling restart dropped nothing")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.fault_plan:
+        from fluxdistributed_tpu import faults
+
+        spec = args.fault_plan
+        if spec.startswith("@"):
+            with open(spec[1:]) as f:
+                spec = f.read()
+        faults.install_plan(faults.FaultPlan.from_spec(json.loads(spec)))
+    if args.rolling_restart:
+        return rolling_restart_client(args.rolling_restart,
+                                      args.drain_timeout)
+    if args.smoke:
+        return run_smoke(args)
+    router, sups = make_router(args)
+    httpd = router.serve(args.host, args.port)
+    print(f"FDTPU_ROUTER_PORT={router.bound_port}", flush=True)
+    print(f"routing {len(router.replicas)} replicas on "
+          f"http://{args.host}:{router.bound_port}/v1/generate "
+          f"(ctrl-c to stop)", flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.close()
+        for sup in sups:
+            sup.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -88,7 +88,11 @@ def build_parser():
     p.add_argument("--torch-weights", default=None)
     p.add_argument("--synset", default=None)
     p.add_argument("--topk", type=int, default=3)
-    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--port", type=int, default=8000,
+                   help="0 binds an ephemeral port; LM mode announces "
+                        "the bound port as an FDTPU_SERVE_PORT=<n> "
+                        "stdout line (and on /healthz) so a router or "
+                        "test can orchestrate a fleet race-free")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--platform", default=None)
     # --- LM serving mode (continuous-batching engine) ---
@@ -187,6 +191,22 @@ def build_parser():
                         "match, else compile now and serialize for the "
                         "next process (skips tracing AND compiling on "
                         "restart; LM mode)")
+    p.add_argument("--fault-plan", default=None, metavar="JSON",
+                   help="install a deterministic fault-injection plan "
+                        "(fluxdistributed_tpu.faults) before serving — "
+                        "JSON object or @path/to/plan.json, e.g. "
+                        "'{\"fail\": [{\"site\": \"serve.tick\", "
+                        "\"at\": 40, \"action\": \"exit\"}]}' is a "
+                        "replica crash at scheduler tick 40 (the "
+                        "router failover test harness)")
+    p.add_argument("--fake-engine", action="store_true",
+                   help="serve a deterministic pure-python engine "
+                        "(serve.testing.FakeLMEngine) instead of a real "
+                        "model — no compiles, instant startup; the "
+                        "router fleet test/dev scaffold (LM mode)")
+    p.add_argument("--fake-step-delay", type=float, default=0.002,
+                   help="seconds each fake-engine decode tick sleeps "
+                        "(gives drains and kills measurable width)")
     return p
 
 
@@ -196,6 +216,17 @@ def make_lm_app(args):
     Separate from HTTP wiring so tests can drive the scheduler directly
     (the ``make_app`` pattern below).
     """
+    if args.fake_engine:
+        # no model, no compiles: the router fleet scaffold — the HTTP/
+        # scheduler surface is real, only the tokens are fake
+        from fluxdistributed_tpu.serve.testing import FakeLMEngine
+
+        engine = FakeLMEngine(max_slots=args.max_slots,
+                              max_len=args.max_len,
+                              step_delay=args.fake_step_delay,
+                              vocab=args.vocab)
+        return _wire_lm_stack(args, engine)
+
     import time
 
     import jax
@@ -205,7 +236,7 @@ def make_lm_app(args):
         jax.config.update("jax_platforms", args.platform)
 
     from fluxdistributed_tpu import compilation, models
-    from fluxdistributed_tpu.serve import LMEngine, LMServer, Scheduler
+    from fluxdistributed_tpu.serve import LMEngine
 
     if args.compile_cache:
         compilation.enable_persistent_cache(args.compile_cache)
@@ -251,6 +282,15 @@ def make_lm_app(args):
     if args.prewarm or args.aot_dir:
         print(f"engine ready in {time.perf_counter() - t0:.1f}s "
               f"(compile_stats={engine.compile_stats()})", file=sys.stderr)
+    return _wire_lm_stack(args, engine)
+
+
+def _wire_lm_stack(args, engine):
+    """Scheduler + LMServer over any engine (real or fake) — ONE place
+    so the fake-engine fleet cannot diverge from the real serving
+    path."""
+    from fluxdistributed_tpu.serve import LMServer, Scheduler
+
     reqtrace = None
     if getattr(args, "trace_requests", None):
         from fluxdistributed_tpu.obs import RequestTracer
@@ -378,6 +418,14 @@ def serve(args, predict):
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if getattr(args, "fault_plan", None):
+        from fluxdistributed_tpu import faults
+
+        spec = args.fault_plan
+        if spec.startswith("@"):
+            with open(spec[1:]) as f:
+                spec = f.read()
+        faults.install_plan(faults.FaultPlan.from_spec(json.loads(spec)))
     if args.lm:
         lm_server, scheduler = make_lm_app(args)
         srv = lm_server.serve(args.host, args.port)
@@ -385,9 +433,13 @@ def main(argv=None) -> int:
         # shut the HTTP server down, exit 0 — the graceful-drain path
         lm_server.install_drain_handler(httpd=srv,
                                         timeout=args.drain_timeout)
+        # the machine-readable bound-port announcement (--port 0 gives
+        # an ephemeral one): routers and tests read THIS line, humans
+        # read the next one
+        print(f"FDTPU_SERVE_PORT={srv.server_address[1]}", flush=True)
         print(f"serving LM on http://{args.host}:{srv.server_address[1]}/"
               f"v1/generate (ctrl-c to stop; SIGTERM drains "
-              f"<= {args.drain_timeout:.0f}s)")
+              f"<= {args.drain_timeout:.0f}s)", flush=True)
         try:
             srv.serve_forever()
         except KeyboardInterrupt:

@@ -40,6 +40,8 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 __all__ = [
+    "FAULT_ACTIONS",
+    "HANG_DELAY_SECONDS",
     "PREEMPTED_RC",
     "UNAVAILABLE_SIGNATURES",
     "BackendUnavailable",
@@ -131,14 +133,28 @@ def _metrics():
 # ---------------------------------------------------------------------------
 
 
+#: every action :meth:`FaultPlan.fail` / ``from_spec``'s ``fail`` key
+#: accepts — the serve-side fault model needs more than exceptions:
+#: ``exit`` is a replica crash (``os._exit`` — no drain, no atexit, the
+#: SIGKILL shape), ``sleep`` is a slow replica (delay then continue),
+#: ``hang`` is a wedged one (delay defaults to an hour — the caller's
+#: timeout machinery is what's under test).
+FAULT_ACTIONS = ("raise", "sigterm", "sigint", "exit", "sleep", "hang")
+
+#: how long a "hang" action sleeps when no explicit delay is given —
+#: far beyond any probe/dispatch/request timeout in the tree
+HANG_DELAY_SECONDS = 3600.0
+
+
 @dataclasses.dataclass
 class _Fault:
     site: str
     at: Optional[int] = None        # trigger only when fire(index=at)
     times: int = 1                  # how many triggers remain
-    action: str = "raise"           # "raise" | "sigterm" | "sigint"
+    action: str = "raise"           # one of FAULT_ACTIONS
     exc: Optional[Callable[[], BaseException]] = None
     message: str = "injected fault"
+    delay: float = 0.0              # seconds for sleep/hang actions
     fired: int = 0                  # triggers delivered so far
 
 
@@ -156,7 +172,16 @@ class FaultPlan:
       before ``jax.devices()``;
     * ``"resume"`` — entry of ``train.resume_training``;
     * ``"checkpoint_save"`` / ``"checkpoint_load"`` — inside the orbax
-      write/read (retried by ``train/checkpoint.py``).
+      write/read (retried by ``train/checkpoint.py``);
+    * ``"serve.tick"`` — top of every serve ``Scheduler.step`` (``index``
+      = the scheduler's tick count; an ``exit`` action here is a
+      deterministic replica kill mid-burst, ``sleep``/``hang`` a slow or
+      wedged engine loop);
+    * ``"serve.dispatch"`` — inside the router's per-request dispatch
+      attempt (retried across replicas by ``with_retries``);
+    * ``"serve.probe"`` — inside the router's health-probe attempt
+      (``index`` = the running probe count; failures feed the circuit
+      breaker without any real outage).
 
     ``params`` is a free-form dict for harness knobs that are not
     exceptions — e.g. ``{"local_devices": 4}`` makes ``bin/driver.py``
@@ -172,11 +197,20 @@ class FaultPlan:
     # -- construction --------------------------------------------------
     def fail(self, site: str, *, at: Optional[int] = None, times: int = 1,
              exc: Optional[Callable[[], BaseException]] = None,
-             message: str = "injected fault") -> "FaultPlan":
-        """Raise an exception at ``site`` (optionally only at occurrence
-        index ``at``), ``times`` times."""
+             message: str = "injected fault", action: str = "raise",
+             delay: float = 0.0) -> "FaultPlan":
+        """Trigger ``action`` at ``site`` (optionally only at occurrence
+        index ``at``), ``times`` times.  The default raises an
+        exception; see :data:`FAULT_ACTIONS` for the kill/slow/hang
+        shapes (``delay`` is the sleep seconds for ``sleep``/``hang``)."""
+        if action not in FAULT_ACTIONS:
+            raise ValueError(
+                f"unknown fault action {action!r}; one of {FAULT_ACTIONS}")
+        if delay < 0:
+            raise ValueError(f"delay must be >= 0, got {delay}")
         self._faults.append(
-            _Fault(site=site, at=at, times=times, exc=exc, message=message))
+            _Fault(site=site, at=at, times=times, exc=exc, message=message,
+                   action=action, delay=float(delay)))
         return self
 
     def sigterm_at_step(self, k: int) -> "FaultPlan":
@@ -212,10 +246,19 @@ class FaultPlan:
              "loader_fail": {"at": 1, "times": 2},
              "backend_unavailable": 2,
              "params": {"local_devices": 4}}
+
+        The generic ``fail`` key addresses any site/action directly —
+        the serve-side surface (replica kill/slow/hang, dispatch and
+        probe failures)::
+
+            {"fail": [{"site": "serve.tick", "at": 40, "action": "exit"},
+                      {"site": "serve.dispatch", "times": 2},
+                      {"site": "serve.probe", "action": "sleep",
+                       "delay": 0.5}]}
         """
         plan = cls()
         known = {"sigterm_at_step", "sigint_at_step", "loader_fail",
-                 "backend_unavailable", "params"}
+                 "backend_unavailable", "params", "fail"}
         unknown = set(spec) - known
         if unknown:
             raise ValueError(
@@ -231,6 +274,22 @@ class FaultPlan:
                              times=int(lf.get("times", 1)))
         if "backend_unavailable" in spec:
             plan.backend_unavailable(int(spec["backend_unavailable"]))
+        for f in spec.get("fail") or []:
+            fkeys = {"site", "at", "times", "action", "delay", "message"}
+            unknown = set(f) - fkeys
+            if unknown:
+                raise ValueError(
+                    f"unknown fail-entry keys {sorted(unknown)}; "
+                    f"supported: {sorted(fkeys)}")
+            if "site" not in f:
+                raise ValueError(f"fail entry needs a site: {f!r}")
+            plan.fail(
+                str(f["site"]),
+                at=None if f.get("at") is None else int(f["at"]),
+                times=int(f.get("times", 1)),
+                action=str(f.get("action", "raise")),
+                delay=float(f.get("delay", 0.0)),
+                message=str(f.get("message", "injected fault")))
         plan.params.update(spec.get("params") or {})
         return plan
 
@@ -239,9 +298,15 @@ class FaultPlan:
         """Trigger any matching fault.  ``raise`` actions raise; signal
         actions ``os.kill`` this process (a python handler — e.g. the
         trainer's :class:`SignalFlag` — runs before the caller's next
-        bytecode, so the very next boundary check observes it)."""
+        bytecode, so the very next boundary check observes it);
+        ``exit`` is an immediate hard kill (``os._exit`` — a crash, not
+        a drain); ``sleep``/``hang`` stall the CALLING thread for the
+        fault's delay and then return (the slow/wedged-replica shapes —
+        everything else in the process keeps running)."""
         to_signal = None
         exc: Optional[BaseException] = None
+        hard_exit = False
+        stall = 0.0
         with self._lock:
             for f in self._faults:
                 if f.site != site or f.fired >= f.times:
@@ -254,12 +319,25 @@ class FaultPlan:
                     to_signal = signal.SIGTERM
                 elif f.action == "sigint":
                     to_signal = signal.SIGINT
+                elif f.action == "exit":
+                    hard_exit = True
+                elif f.action in ("sleep", "hang"):
+                    stall = f.delay if (
+                        f.action == "sleep" or f.delay > 0
+                    ) else HANG_DELAY_SECONDS
                 else:
                     exc = f.exc() if f.exc is not None else FaultInjected(
                         f"{f.message} (site={site}, index={index})")
                 break
+        if hard_exit:
+            # the un-drainable crash: no atexit, no finally blocks —
+            # the same shape as SIGKILL/OOM, which is the point
+            os._exit(1)
         if to_signal is not None:
             os.kill(os.getpid(), to_signal)
+            return
+        if stall > 0:
+            time.sleep(stall)
             return
         if exc is not None:
             raise exc

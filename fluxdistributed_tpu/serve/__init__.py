@@ -6,6 +6,8 @@ a fixed pool of compiled XLA programs."""
 
 from .cache_layout import BlockPool, DenseLayout, PagedLayout
 from .engine import DEFAULT_BUCKETS, DEFAULT_KV_BLOCK_SIZE, LMEngine
+from .router import (NoReplicaAvailable, Replica, Router, RouterError,
+                     SupervisedReplica)
 from .scheduler import Draining, QueueFull, Request, Scheduler
 from .server import LMServer, serve_lm
 
@@ -17,9 +19,14 @@ __all__ = [
     "Draining",
     "LMEngine",
     "LMServer",
+    "NoReplicaAvailable",
     "PagedLayout",
     "QueueFull",
+    "Replica",
     "Request",
+    "Router",
+    "RouterError",
     "Scheduler",
+    "SupervisedReplica",
     "serve_lm",
 ]

@@ -46,6 +46,7 @@ from typing import Callable, List, Optional, Sequence
 import jax
 import numpy as np
 
+from .. import faults
 from ..obs.metrics import Registry
 from ..obs.reqtrace import RequestTracer
 from .engine import LMEngine
@@ -136,6 +137,7 @@ class Scheduler:
         #: raise it to favor prompt ingestion over decode latency
         self.prefill_chunks_per_tick = prefill_chunks_per_tick
         self._rr = -1  # round-robin cursor over prefilling slots
+        self._ticks = 0  # step() calls — the serve.tick fault index
         #: graceful-drain latch (see :meth:`begin_drain`): True refuses
         #: NEW submissions while everything already accepted (queued or
         #: in a slot) runs to completion
@@ -412,6 +414,12 @@ class Scheduler:
         slots freed THIS tick), then advance at most
         ``prefill_chunks_per_tick`` prefill chunks (chunked engines).
         Returns the number of tokens emitted."""
+        # deterministic serve-side injection point: a plan can crash
+        # (action "exit"), stall, or raise at tick k — the replica-kill
+        # and wedged-loop scenarios the router's failover tests need.
+        # One global None check when no plan is installed.
+        faults.fire("serve.tick", index=self._ticks)
+        self._ticks += 1
         emitted = 0
         self._service_cancels()
         live = [s for s, r in enumerate(self.slots)

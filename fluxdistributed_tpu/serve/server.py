@@ -55,6 +55,10 @@ class LMServer:
         self.scheduler = scheduler
         self.vocab = vocab
         self.request_timeout = request_timeout
+        #: the port :meth:`serve` actually bound (``--port 0`` gives an
+        #: ephemeral one); surfaced on /healthz so a router or test
+        #: orchestrating a fleet can discover it race-free
+        self.bound_port: Optional[int] = None
         self._stop = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
         self.loop_errors = 0
@@ -259,6 +263,8 @@ class LMServer:
                         "queue_depth": sched.queue_depth,
                         "loop_errors": outer.loop_errors,
                     }
+                    if outer.bound_port is not None:
+                        body["port"] = outer.bound_port
                     if outer.last_loop_error:
                         body["last_loop_error"] = outer.last_loop_error
                     self._send_json(
@@ -422,8 +428,10 @@ class LMServer:
         import http.server
 
         self.start_loop()
-        return http.server.ThreadingHTTPServer((host, port),
-                                               self.make_handler())
+        httpd = http.server.ThreadingHTTPServer((host, port),
+                                                self.make_handler())
+        self.bound_port = httpd.server_address[1]
+        return httpd
 
 
 def serve_lm(scheduler: Scheduler, vocab: int, host: str = "127.0.0.1",

@@ -171,6 +171,72 @@ def test_from_spec_roundtrip_and_unknown_keys():
         faults.FaultPlan.from_spec({"sigsegv_at_step": 1})
 
 
+def test_from_spec_generic_fail_entries():
+    """The serve-side surface: the ``fail`` key addresses any
+    site/action directly (router and replica fault harness)."""
+    plan = faults.FaultPlan.from_spec({
+        "fail": [{"site": "serve.tick", "at": 3, "times": 1},
+                 {"site": "serve.dispatch", "times": 2,
+                  "message": "router chaos"}],
+    })
+    faults.install_plan(plan)
+    faults.fire("serve.tick", index=0)  # wrong index: no trigger
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("serve.tick", index=3)
+    for _ in range(2):
+        with pytest.raises(faults.FaultInjected, match="router chaos"):
+            faults.fire("serve.dispatch")
+    faults.fire("serve.dispatch")  # budget spent
+    reg = faults._metrics()
+    assert reg["injected"].value("serve.tick") >= 1
+    assert reg["injected"].value("serve.dispatch") >= 2
+
+
+def test_from_spec_fail_entry_validation():
+    with pytest.raises(ValueError, match="unknown fail-entry keys"):
+        faults.FaultPlan.from_spec(
+            {"fail": [{"site": "x", "when": 3}]})
+    with pytest.raises(ValueError, match="needs a site"):
+        faults.FaultPlan.from_spec({"fail": [{"at": 3}]})
+    with pytest.raises(ValueError, match="unknown fault action"):
+        faults.FaultPlan.from_spec(
+            {"fail": [{"site": "x", "action": "segfault"}]})
+    with pytest.raises(ValueError, match="delay must be >= 0"):
+        faults.FaultPlan().fail("x", action="sleep", delay=-1)
+
+
+def test_sleep_and_hang_actions_stall_then_return():
+    faults.install_plan(
+        faults.FaultPlan()
+        .fail("slow_site", action="sleep", delay=0.05)
+        .fail("hang_site", action="hang", delay=0.05))
+    t0 = time.monotonic()
+    faults.fire("slow_site")  # returns (slow, not raising)
+    assert time.monotonic() - t0 >= 0.05
+    t0 = time.monotonic()
+    faults.fire("hang_site")  # explicit delay bounds the "hang" in tests
+    assert time.monotonic() - t0 >= 0.05
+    # with no delay a hang would stall for the documented default
+    assert faults.HANG_DELAY_SECONDS >= 600
+
+
+def test_serve_tick_site_fires_in_scheduler_step():
+    """The scheduler's per-tick injection point: tick k raises inside
+    step() — and the LMServer engine loop is built to survive exactly
+    this (loop_errors counts it, serving continues)."""
+    from fluxdistributed_tpu.serve import Scheduler
+    from fluxdistributed_tpu.serve.testing import FakeLMEngine
+
+    sched = Scheduler(FakeLMEngine(), max_queue=4)
+    faults.install_plan(
+        faults.FaultPlan.from_spec(
+            {"fail": [{"site": "serve.tick", "at": 1}]}))
+    sched.step()  # tick 0: clean
+    with pytest.raises(faults.FaultInjected):
+        sched.step()  # tick 1: injected
+    sched.step()  # tick 2: clean again
+
+
 def test_sigterm_fault_sets_signal_flag():
     """The deterministic preemption: plan fires SIGTERM at step k, a
     SignalFlag handler records it, the process survives."""

@@ -1,0 +1,734 @@
+"""N-replica router (serve/router.py) — fast tier, FakeEngine replicas
+over live HTTP (no compiles).
+
+The router's contract is about processes and sockets: health-checked
+replica registry, per-replica circuit breakers, pre-first-token
+failover with the request id preserved, least-loaded dispatch off the
+queue-wait rollup, drain-aware routing, the fleet metrics/trace
+rollups, and zero-downtime rolling restarts.  Probes are driven
+MANUALLY (``probe_now``) throughout so every transition is
+deterministic — no sleeping on prober-thread timing.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from fluxdistributed_tpu import faults
+from fluxdistributed_tpu.obs import RequestTracer
+from fluxdistributed_tpu.serve import (LMServer, Replica, Router,
+                                       RouterError, Scheduler)
+from fluxdistributed_tpu.serve.testing import FakeLMEngine, fake_tokens
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_fault_plan():
+    yield
+    faults.clear_plan()
+
+
+class _Rep:
+    """One in-process replica: FakeLMEngine scheduler + LMServer +
+    live ThreadingHTTPServer."""
+
+    def __init__(self, step_delay=0.001, max_queue=16, trace=False,
+                 max_slots=4):
+        self.engine = FakeLMEngine(step_delay=step_delay,
+                                   max_slots=max_slots)
+        self.sched = Scheduler(self.engine, max_queue=max_queue,
+                               reqtrace=RequestTracer() if trace else None)
+        self.srv = LMServer(self.sched, vocab=256)
+        self.httpd = self.srv.serve("127.0.0.1", 0)
+        # tight poll so teardown's shutdown() returns in ~ms, not 0.5s
+        self.thread = threading.Thread(
+            target=lambda: self.httpd.serve_forever(poll_interval=0.02),
+            daemon=True)
+        self.thread.start()
+        self.url = f"http://127.0.0.1:{self.srv.bound_port}"
+
+    def kill(self):
+        """Hard in-process death: the port stops answering."""
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.srv.stop_loop()
+
+    def close(self):
+        try:
+            self.kill()
+        except OSError:
+            pass
+        self.srv.close()
+
+
+@pytest.fixture
+def fleet(request):
+    made = []
+
+    def make(n=2, **kw):
+        reps = [_Rep(**kw) for _ in range(n)]
+        made.extend(reps)
+        return reps
+
+    yield make
+    for r in made:
+        r.close()
+
+
+def make_router(reps, **kw):
+    """Router over in-process replicas with manual probing (the prober
+    interval is effectively infinite; tests call probe_now)."""
+    kw.setdefault("probe_interval", 3600.0)
+    kw.setdefault("probe_timeout", 5.0)
+    kw.setdefault("failure_threshold", 2)
+    kw.setdefault("breaker_cooldown", 0.2)
+    kw.setdefault("dispatch_tries", 3)
+    kw.setdefault("dispatch_backoff", 0.01)
+    kw.setdefault("upstream_timeout", 60.0)
+    router = Router(
+        [Replica(f"r{i}", r.url) for i, r in enumerate(reps)], **kw)
+    return router
+
+
+@pytest.fixture
+def served(request):
+    """Start the router's front HTTP server; yields base-url factory."""
+    started = []
+
+    def start(router):
+        httpd = router.serve("127.0.0.1", 0)
+        t = threading.Thread(
+            target=lambda: httpd.serve_forever(poll_interval=0.02),
+            daemon=True)
+        t.start()
+        started.append((router, httpd))
+        return f"http://127.0.0.1:{router.bound_port}"
+
+    yield start
+    for router, httpd in started:
+        httpd.shutdown()
+        httpd.server_close()
+        router.close()
+
+
+def _post(base, body, rid=None, timeout=30):
+    headers = {"Content-Type": "application/json"}
+    if rid:
+        headers["X-Request-Id"] = rid
+    req = urllib.request.Request(
+        f"{base}/v1/generate", data=json.dumps(body).encode(),
+        method="POST", headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get(base, path, timeout=10):
+    try:
+        with urllib.request.urlopen(f"{base}{path}", timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# ---------------------------------------------------------------------------
+# health + breaker state machine
+# ---------------------------------------------------------------------------
+
+
+def test_probe_health_then_failures_open_breaker(fleet):
+    a, b = fleet(2)
+    router = make_router([a, b])
+    try:
+        router.probe_now()
+        r0, r1 = router.replicas
+        assert r0.healthy and r1.healthy
+        assert router.registry.value(
+            "fdtpu_router_replica_healthy", "r0") == 1
+        a.kill()
+        # threshold is 2 consecutive failures: first probe degrades,
+        # second opens
+        router.probe_now()
+        assert not r0.healthy and r0.breaker == "closed"
+        router.probe_now()
+        assert r0.breaker == "open"
+        assert router.registry.value(
+            "fdtpu_router_breaker_state", "r0") == 2
+        assert router.registry.value(
+            "fdtpu_router_breaker_opens_total", "r0") == 1
+        # the healthy replica keeps the fleet serving
+        h = router.health()
+        assert h["ok"] and h["dispatchable"] == 1
+    finally:
+        router.close()
+
+
+def test_half_open_trial_request_recloses_breaker(fleet, served):
+    """The open → half-open → closed path driven by a TRIAL REQUEST
+    (not a probe): after the cooldown the next dispatch is allowed one
+    trial on the suspect replica; its success re-closes the breaker."""
+    (a,) = fleet(1)
+    router = make_router([a], failure_threshold=1, breaker_cooldown=0.05)
+    base = served(router)
+    router.probe_now()
+    a.kill()
+    code, body, _ = _post(base, {"prompt_tokens": [1], "max_tokens": 2})
+    assert code in (502, 503), body
+    assert router.replicas[0].breaker == "open"
+    # replica returns on the SAME port (allow_reuse_address) — only a
+    # trial request may discover that, probes are off
+    a.httpd = a.srv.serve("127.0.0.1", a.srv.bound_port)
+    threading.Thread(
+        target=lambda: a.httpd.serve_forever(poll_interval=0.02),
+        daemon=True).start()
+    time.sleep(0.06)  # past the cooldown
+    code, body, _ = _post(base, {"prompt_tokens": [1, 2], "max_tokens": 3})
+    assert code == 200, body
+    assert body["generated"] == fake_tokens([1, 2], 3)
+    assert router.replicas[0].breaker == "closed"
+
+
+def test_probe_success_also_recovers_open_breaker(fleet):
+    a, b = fleet(2)
+    router = make_router([a, b], failure_threshold=1)
+    try:
+        router.probe_now()
+        a.kill()
+        router.probe_now()
+        assert router.replicas[0].breaker == "open"
+        a.httpd = a.srv.serve("127.0.0.1", a.srv.bound_port)
+        threading.Thread(
+            target=lambda: a.httpd.serve_forever(poll_interval=0.02),
+            daemon=True).start()
+        router.probe_now()
+        assert router.replicas[0].breaker == "closed"
+        assert router.replicas[0].healthy
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# dispatch: failover, request-id preservation, determinism
+# ---------------------------------------------------------------------------
+
+
+def test_failover_pre_first_token_preserves_request_id(fleet, served):
+    """A replica that died since its last probe still LOOKS healthy —
+    dispatch discovers the death, fails over, and the client sees one
+    clean 200 with its own X-Request-Id and the exact tokens the dead
+    replica would have produced (pure-function engine = the greedy
+    determinism the guarantee rides on in production)."""
+    a, b = fleet(2)
+    router = make_router([a, b])
+    base = served(router)
+    router.probe_now()  # both healthy
+    a.kill()            # ...but the router does not know yet
+    hit_dead = False
+    for i in range(4):  # round-robin guarantees the dead one is tried
+        rid = f"req-{i}"
+        code, body, headers = _post(
+            base, {"prompt_tokens": [i + 1, 2], "max_tokens": 5}, rid=rid)
+        assert code == 200, body
+        assert body["request_id"] == rid
+        assert headers.get("X-Request-Id") == rid
+        assert body["generated"] == fake_tokens([i + 1, 2], 5)
+        hit_dead = hit_dead or headers.get("X-Fdtpu-Replica") == "r1"
+    assert router.registry.value(
+        "fdtpu_router_dispatch_failures_total", "r0") >= 1
+    assert router.registry.value("fdtpu_router_failovers_total") >= 1
+
+
+def test_injected_dispatch_fault_is_retried(fleet, served):
+    """serve.dispatch injection: the first dispatch attempt raises
+    inside the router (no replica involved) and the retry completes —
+    the failover machinery is provable with zero real failures."""
+    (a,) = fleet(1)
+    router = make_router([a])
+    base = served(router)
+    router.probe_now()
+    faults.install_plan(faults.FaultPlan().fail("serve.dispatch", times=1))
+    code, body, _ = _post(base, {"prompt_tokens": [9], "max_tokens": 3})
+    assert code == 200, body
+    assert body["generated"] == fake_tokens([9], 3)
+    assert router.registry.value("fdtpu_router_failovers_total") >= 1
+    reg = faults._metrics()
+    assert reg["injected"].value("serve.dispatch") >= 1
+
+
+def test_all_replicas_down_returns_503(fleet, served):
+    (a,) = fleet(1)
+    router = make_router([a], dispatch_tries=2, dispatch_backoff=0.0)
+    base = served(router)
+    router.probe_now()
+    a.kill()
+    router.probe_now()
+    router.probe_now()  # breaker open; nothing dispatchable
+    code, body, _ = _post(base, {"prompt_tokens": [1], "max_tokens": 2})
+    assert code == 503, body
+    assert "no dispatchable replica" in body["error"]
+    assert "request_id" in body
+
+
+def test_replica_5xx_fails_over_and_feeds_breaker(fleet, served):
+    """A 5xx from a replica is the REPLICA's failure: nothing reached
+    the client, so the router must retry elsewhere and count the
+    failure — not pass the 500 through and reset the breaker."""
+    import http.server as hs
+
+    class Broken(hs.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):  # healthz: looks perfectly healthy
+            body = json.dumps({"ok": True, "draining": False}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):  # every generate blows up server-side
+            body = json.dumps({"error": "engine exploded"}).encode()
+            self.send_response(500)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    broken = hs.ThreadingHTTPServer(("127.0.0.1", 0), Broken)
+    threading.Thread(
+        target=lambda: broken.serve_forever(poll_interval=0.02),
+        daemon=True).start()
+    (good,) = fleet(1)
+    router = Router(
+        [Replica("r0", f"http://127.0.0.1:{broken.server_address[1]}"),
+         Replica("r1", good.url)],
+        probe_interval=3600.0, failure_threshold=2,
+        dispatch_backoff=0.0, upstream_timeout=30.0)
+    base = served(router)
+    try:
+        router.probe_now()
+        for i in range(4):  # round-robin makes r0 answer 500 at least once
+            code, body, headers = _post(
+                base, {"prompt_tokens": [i + 1], "max_tokens": 3})
+            assert code == 200, body
+            assert headers.get("X-Fdtpu-Replica") == "r1"
+            assert body["generated"] == fake_tokens([i + 1], 3)
+        assert router.registry.value(
+            "fdtpu_router_dispatch_failures_total", "r0") >= 1
+        assert router.replicas[0].consecutive_failures >= 1
+    finally:
+        broken.shutdown()
+        broken.server_close()
+
+
+def test_client_errors_pass_through_without_failover(fleet, served):
+    a, b = fleet(2)
+    router = make_router([a, b])
+    base = served(router)
+    router.probe_now()
+    code, body, _ = _post(base, {"max_tokens": 4})  # no prompt: 400
+    assert code == 400
+    assert router.registry.value("fdtpu_router_failovers_total") == 0
+    # a replying replica is a LIVE replica — no breaker movement
+    assert all(r.breaker == "closed" for r in router.replicas)
+
+
+# ---------------------------------------------------------------------------
+# streaming: retry before first token, fail fast after
+# ---------------------------------------------------------------------------
+
+
+def test_stream_failover_before_first_token(fleet, served):
+    a, b = fleet(2)
+    router = make_router([a, b])
+    base = served(router)
+    router.probe_now()
+    a.kill()
+    for i in range(3):
+        req = urllib.request.Request(
+            f"{base}/v1/generate",
+            data=json.dumps({"prompt_tokens": [7, i], "max_tokens": 4,
+                             "stream": True}).encode(),
+            method="POST", headers={"X-Request-Id": f"s-{i}"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.headers["X-Request-Id"] == f"s-{i}"
+            lines = [json.loads(x)
+                     for x in r.read().decode().strip().splitlines()]
+        toks = [x["token"] for x in lines if "token" in x]
+        assert toks == fake_tokens([7, i], 4)
+        assert lines[-1]["done"] is True
+    assert router.registry.value(
+        "fdtpu_router_dispatch_failures_total", "r0") >= 1
+
+
+def test_stream_after_first_token_fails_fast_naming_replica(fleet, served):
+    """Once a token has been forwarded, an upstream stall/death cannot
+    be transparently retried — the stream must end promptly with an
+    error line naming the replica, NOT hang for the full request
+    timeout or silently report done."""
+    (a,) = fleet(1)
+    a.engine.step_delay = 0.01
+    router = make_router([a], upstream_timeout=0.8)
+    base = served(router)
+    router.probe_now()
+
+    def wedge_soon():
+        time.sleep(0.1)  # a few tokens out first
+        a.engine.step_delay = 2.0  # the replica wedges mid-decode
+        # (2s >> the 0.8s upstream timeout, small enough that the
+        # sleeping loop thread wakes before teardown's join gives up)
+
+    threading.Thread(target=wedge_soon, daemon=True).start()
+    req = urllib.request.Request(
+        f"{base}/v1/generate",
+        data=json.dumps({"prompt_tokens": [3, 4], "max_tokens": 500,
+                         "stream": True}).encode(), method="POST")
+    t0 = time.monotonic()
+    with urllib.request.urlopen(req, timeout=30) as r:
+        lines = [json.loads(x)
+                 for x in r.read().decode().strip().splitlines()]
+    assert time.monotonic() - t0 < 10
+    assert any("token" in x for x in lines), lines
+    last = lines[-1]
+    assert last["done"] is False
+    assert "r0" in last["error"] and "mid-stream" in last["error"]
+    assert last["replica"] == "r0"
+    assert router.registry.value(
+        "fdtpu_router_midstream_failures_total") == 1
+    assert router.registry.value("fdtpu_router_failovers_total") == 0
+    a.engine.step_delay = 0.0  # unwedge for teardown
+
+
+def test_client_disconnect_midstream_does_not_blame_replica(fleet, served):
+    """A CLIENT leaving mid-stream is not the replica's fault: no
+    breaker movement, no mid-stream-failure tally (regression: a write
+    failure is also an OSError and must not be classified as an
+    upstream death)."""
+    import http.client as hc
+
+    (a,) = fleet(1, step_delay=0.01)
+    router = make_router([a], failure_threshold=1)
+    base = served(router)
+    router.probe_now()
+    host, port = "127.0.0.1", router.bound_port
+    conn = hc.HTTPConnection(host, port, timeout=10)
+    conn.request("POST", "/v1/generate",
+                 body=json.dumps({"prompt_tokens": [1, 2],
+                                  "max_tokens": 200, "stream": True}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    resp.read(8)  # a token or two have flowed
+    conn.sock.close()  # the client walks away mid-stream
+    deadline = time.monotonic() + 5
+    while router.replicas[0].inflight and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert router.replicas[0].inflight == 0
+    assert router.replicas[0].breaker == "closed"
+    assert router.replicas[0].consecutive_failures == 0
+    assert router.registry.value(
+        "fdtpu_router_midstream_failures_total") == 0
+    a.engine.step_delay = 0.0  # let the abandoned decode finish fast
+
+
+# ---------------------------------------------------------------------------
+# drain-under-load ordering (the rolling-restart building block)
+# ---------------------------------------------------------------------------
+
+
+def test_drain_under_load_streams_finish_and_router_routes_around(
+        fleet, served):
+    """The SIGTERM-shaped drain under live traffic: a stream in flight
+    on the draining replica runs to completion, the replica's own 503
+    carries draining:true, the router treats draining as out-of-rotation
+    (NOT a breaker failure) and re-dispatches new work to the healthy
+    replica."""
+    a, b = fleet(2, step_delay=0.01)
+    router = make_router([a, b])
+    base = served(router)
+    router.probe_now()
+
+    # a long stream pinned mid-flight on A (direct submit, so the test
+    # controls which replica drains under it)
+    stream_lines = []
+    stream_done = threading.Event()
+
+    def long_stream():
+        req = urllib.request.Request(
+            f"{a.url}/v1/generate",
+            data=json.dumps({"prompt_tokens": [5, 6], "max_tokens": 60,
+                             "stream": True}).encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=60) as r:
+            for line in r:
+                stream_lines.append(json.loads(line))
+        stream_done.set()
+
+    t = threading.Thread(target=long_stream, daemon=True)
+    t.start()
+    while not any("token" in x for x in stream_lines):
+        time.sleep(0.005)  # mid-stream
+
+    # the bin/serve.py SIGTERM handler shape: drain on a background
+    # thread (test_serve_drain covers the real-signal wiring)
+    drain_result = {}
+    dt = threading.Thread(
+        target=lambda: drain_result.setdefault("ok", a.srv.drain(30.0)),
+        daemon=True)
+    dt.start()
+    while not a.sched.draining:
+        time.sleep(0.001)
+
+    # 1. queued-at-the-replica behavior: a direct submit gets 503 with
+    #    draining:true — the router's cue to go elsewhere
+    code, body, _ = _post(a.url, {"prompt_tokens": [1], "max_tokens": 2})
+    assert code == 503 and body.get("draining") is True
+
+    # 2. the router re-dispatches around the draining replica: before
+    #    any probe ran, round-robin still tries A, absorbs its 503 and
+    #    completes on B; afterwards A is marked draining
+    for i in range(4):
+        code, body, headers = _post(
+            base, {"prompt_tokens": [8, i], "max_tokens": 4},
+            rid=f"d-{i}")
+        assert code == 200, body
+        assert headers.get("X-Fdtpu-Replica") == "r1"
+        assert body["generated"] == fake_tokens([8, i], 4)
+    r0 = router.replicas[0]
+    assert r0.draining is True
+    # 3. a deliberate drain is NOT a failure: breaker untouched
+    assert r0.breaker == "closed" and r0.consecutive_failures == 0
+    router.probe_now()
+    assert r0.breaker == "closed"
+    h = router.health()
+    assert h["ok"] and h["dispatchable"] == 1
+
+    # 4. the in-flight stream on A completed fully
+    dt.join(timeout=60)
+    assert stream_done.wait(timeout=60)
+    toks = [x["token"] for x in stream_lines if "token" in x]
+    assert toks == fake_tokens([5, 6], 60), "drain cut a stream short"
+    assert stream_lines[-1]["done"] is True
+    assert drain_result["ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# least-loaded dispatch off the queue-wait rollup
+# ---------------------------------------------------------------------------
+
+
+def test_least_loaded_prefers_low_queue_wait_p50(fleet, served):
+    a, b = fleet(2)
+    router = make_router([a, b])
+    base = served(router)
+    # A's queue-wait p50 rollup says requests wait ~1s there; B has no
+    # samples (NaN = unloaded)
+    for _ in range(4):
+        a.sched._h_queue_wait.observe(1.0)
+    router.probe_now()  # scrapes both replicas' /metrics
+    r0 = router.replicas[0]
+    assert r0.queue_wait_p50 > 0.1
+    assert math.isnan(router.replicas[1].queue_wait_p50)
+    for i in range(4):
+        code, _, headers = _post(
+            base, {"prompt_tokens": [i + 1], "max_tokens": 2})
+        assert code == 200
+        assert headers.get("X-Fdtpu-Replica") == "r1", (
+            "least-loaded dispatch must prefer the unloaded replica")
+
+
+def test_stale_metrics_fall_back_to_round_robin(fleet, served):
+    a, b = fleet(2)
+    router = make_router([a, b], metrics_stale_after=0.5)
+    base = served(router)
+    for _ in range(4):
+        a.sched._h_queue_wait.observe(1.0)
+    router.probe_now()
+    with router._lock:
+        for rep in router.replicas:
+            rep.load_at -= 100.0  # both scrapes long stale
+    seen = set()
+    for i in range(4):
+        code, _, headers = _post(
+            base, {"prompt_tokens": [i + 1], "max_tokens": 2})
+        assert code == 200
+        seen.add(headers.get("X-Fdtpu-Replica"))
+    assert seen == {"r0", "r1"}, (
+        "stale load truth must fall back to round-robin, not keep "
+        "trusting it")
+
+
+# ---------------------------------------------------------------------------
+# fleet rollups: /metrics parity pin, /healthz, /trace stitching
+# ---------------------------------------------------------------------------
+
+
+def _family_names(text, prefix="fdtpu_serve_"):
+    return {line.split(" ")[2] for line in text.splitlines()
+            if line.startswith("# TYPE " + prefix)}
+
+
+def test_metrics_rollup_names_byte_identical(fleet, served):
+    """The parity pin: every fdtpu_serve_* family a replica exposes
+    appears under the SAME name in the router rollup (with a replica
+    label on each series) — PRs 3/6/9's byte-identical guarantee
+    extended through the router."""
+    a, b = fleet(2)
+    router = make_router([a, b])
+    base = served(router)
+    router.probe_now()
+    _post(base, {"prompt_tokens": [1, 2], "max_tokens": 3})
+    _, direct = _get(a.url, "/metrics")
+    direct = direct.decode()
+    _, rolled = _get(base, "/metrics")
+    rolled = rolled.decode()
+    direct_names = _family_names(direct)
+    assert direct_names  # the pin is vacuous if the scrape broke
+    assert _family_names(rolled) == direct_names
+    # every rolled serve series carries the replica label
+    for line in rolled.splitlines():
+        if line.startswith("fdtpu_serve_"):
+            assert 'replica="' in line, line
+    # and the router's own series ride the same page
+    assert "# TYPE fdtpu_router_breaker_state gauge" in rolled
+    assert 'fdtpu_router_dispatches_total{replica="' in rolled
+
+
+def test_healthz_rollup_shape(fleet, served):
+    a, b = fleet(2)
+    router = make_router([a, b])
+    base = served(router)
+    router.probe_now()
+    code, raw = _get(base, "/healthz")
+    assert code == 200
+    h = json.loads(raw)
+    assert h["ok"] and h["dispatchable"] == 2 and h["role"] == "router"
+    names = {r["name"] for r in h["replicas"]}
+    assert names == {"r0", "r1"}
+    for r in h["replicas"]:
+        assert r["breaker"] == "closed" and r["healthy"]
+    a.kill()
+    b.kill()
+    router.probe_now()
+    router.probe_now()
+    code, raw = _get(base, "/healthz")
+    assert code == 503
+    assert json.loads(raw)["ok"] is False
+
+
+def test_trace_rollup_stitches_replica_timelines(fleet, served):
+    a, b = fleet(2, trace=True)
+    router = make_router([a, b])
+    base = served(router)
+    router.probe_now()
+    for i in range(4):
+        code, _, _ = _post(base, {"prompt_tokens": [i + 1],
+                                  "max_tokens": 2}, rid=f"tr-{i}")
+        assert code == 200
+    code, raw = _get(base, "/trace")
+    assert code == 200
+    doc = json.loads(raw)
+    pids = {e["pid"] for e in doc["traceEvents"]}
+    assert pids == {1, 2}, "one process row per replica"
+    labels = {e["args"]["name"] for e in doc["traceEvents"]
+              if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert any("r0" in x for x in labels)
+    assert any("r1" in x for x in labels)
+    # the client-supplied ids stitched through: every enqueue event in
+    # the fleet timeline belongs to a known request id
+    enq = [e for e in doc["traceEvents"] if e.get("name") == "enqueue"]
+    assert len(enq) == 4
+    assert {r["name"] for r in doc["otherData"]["replicas"]} == {
+        "r0", "r1"}
+
+
+# ---------------------------------------------------------------------------
+# rolling restart
+# ---------------------------------------------------------------------------
+
+
+def test_rolling_restart_zero_drops_under_load(fleet, served):
+    """The in-process rolling restart: each replica's restart hook
+    tears the old server down and brings a successor up on a fresh
+    port, one replica at a time, while a client keeps sending requests
+    through the router — none may fail."""
+    reps = fleet(2, step_delay=0.002)
+
+    def make_restart(idx):
+        def restart(replica):
+            old = reps[idx]
+            old.close()
+            reps[idx] = _Rep(step_delay=0.002)
+            return reps[idx].url
+        return restart
+
+    router = Router(
+        [Replica(f"r{i}", r.url, restart=make_restart(i))
+         for i, r in enumerate(reps)],
+        probe_interval=3600.0, failure_threshold=2,
+        dispatch_backoff=0.01, upstream_timeout=30.0)
+    base = served(router)
+    router.probe_now()
+    stop = threading.Event()
+    outcomes = []
+
+    def load():
+        i = 0
+        while not stop.is_set():
+            code, body, _ = _post(
+                base, {"prompt_tokens": [i % 9 + 1], "max_tokens": 3},
+                timeout=30)
+            outcomes.append((i, code, body))
+            i += 1
+            time.sleep(0.01)
+
+    t = threading.Thread(target=load, daemon=True)
+    t.start()
+    old_urls = [r.url for r in router.replicas]
+    try:
+        results = router.rolling_restart(drain_timeout=10.0,
+                                         ready_timeout=10.0)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert len(results) == 2
+    new_urls = [r.url for r in router.replicas]
+    assert set(new_urls).isdisjoint(old_urls), "successors on new ports"
+    assert all(r["drained_clean"] for r in results)
+    bad = [(i, c, b) for i, c, b in outcomes if c != 200]
+    assert not bad, f"rolling restart dropped requests: {bad[:3]}"
+    assert len(outcomes) > 0
+    assert all(rep.healthy and rep.breaker == "closed"
+               for rep in router.replicas)
+    assert router.registry.value(
+        "fdtpu_router_restarts_total", "r0") == 1
+    # and the fleet still serves
+    code, body, _ = _post(base, {"prompt_tokens": [2, 3],
+                                 "max_tokens": 4})
+    assert code == 200 and body["generated"] == fake_tokens([2, 3], 4)
+
+
+def test_rolling_restart_requires_restart_hooks(fleet):
+    a, b = fleet(2)
+    router = make_router([a, b])
+    try:
+        router.probe_now()
+        with pytest.raises(RouterError, match="restart hook"):
+            router.rolling_restart()
+    finally:
+        router.close()
+
+
+def test_duplicate_replica_name_rejected(fleet):
+    (a,) = fleet(1)
+    router = make_router([a])
+    try:
+        with pytest.raises(RouterError, match="duplicate"):
+            router.add_replica(Replica("r0", a.url))
+    finally:
+        router.close()

@@ -1,0 +1,248 @@
+"""Router end-to-end over real replica PROCESSES (bin/serve.py fleets).
+
+The in-process suite (test_router.py) proves the state machines; this
+one proves them against real process death: replicas are ``bin/serve.py
+--lm`` subprocesses orchestrated through the ``--port 0`` +
+``FDTPU_SERVE_PORT=`` contract, and the mid-burst kill is a
+deterministic fault plan (``serve.tick`` → ``exit``, the SIGKILL/OOM
+shape — no drain, no goodbye).
+
+Fast tier: fake-engine replicas (no compiles — subprocess cost is the
+jax import).  Slow tier: real lm_tiny engines sharing one AOT
+executable pool, where a rolling restart must come back at ONE decode
+compile.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from fluxdistributed_tpu.serve.router import (Replica, Router,
+                                              SupervisedReplica,
+                                              wait_http_ready)
+from fluxdistributed_tpu.serve.testing import fake_tokens
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+SERVE = str(ROOT / "bin" / "serve.py")
+ENV = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": str(ROOT)}
+
+
+def _fake_argv(extra=()):
+    return [sys.executable, SERVE, "--lm", "--fake-engine",
+            "--max-slots", "4", "--max-len", "256", "--max-queue", "64",
+            "--fake-step-delay", "0.004", "--port", "0", *extra]
+
+
+def _spawn_fleet(argvs, names):
+    """Spawn all replicas concurrently (each pays a jax import).
+    verbose=False: replica logs interleaving with pytest's progress
+    lines corrupt the tier-1 dot counting."""
+    sups = [SupervisedReplica(argv, name=name, env=ENV, verbose=False)
+            for argv, name in zip(argvs, names)]
+    urls = [None] * len(sups)
+
+    def go(i):
+        urls[i] = sups[i].spawn()
+
+    threads = [threading.Thread(target=go, args=(i,))
+               for i in range(len(sups))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for url in urls:
+        wait_http_ready(url + "/healthz", timeout=60)
+    return sups, urls
+
+
+def _post(base, body, rid=None, timeout=60):
+    headers = {"Content-Type": "application/json"}
+    if rid:
+        headers["X-Request-Id"] = rid
+    req = urllib.request.Request(
+        f"{base}/v1/generate", data=json.dumps(body).encode(),
+        method="POST", headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_kill_midburst_failover_and_breaker_recovery(tmp_path):
+    """The acceptance core: 2-replica fleet over live HTTP, one replica
+    hard-killed mid-burst by a deterministic fault plan.  Every request
+    completes via failover with its X-Request-Id intact and
+    byte-identical tokens; the dead replica's breaker opens; once the
+    replica is brought back at its old port the breaker recovers."""
+    kill_plan = json.dumps(
+        {"fail": [{"site": "serve.tick", "at": 30, "action": "exit"}]})
+    sups, urls = _spawn_fleet(
+        [_fake_argv(["--fault-plan", kill_plan]), _fake_argv()],
+        ["r0", "r1"])
+    router = Router(
+        [Replica("r0", urls[0], restart=sups[0].restart),
+         Replica("r1", urls[1], restart=sups[1].restart)],
+        probe_interval=3600.0, probe_timeout=5.0, failure_threshold=2,
+        breaker_cooldown=0.2, dispatch_tries=4, dispatch_backoff=0.02,
+        upstream_timeout=60.0)
+    httpd = router.serve("127.0.0.1", 0)
+    threading.Thread(
+        target=lambda: httpd.serve_forever(poll_interval=0.02),
+        daemon=True).start()
+    base = f"http://127.0.0.1:{router.bound_port}"
+    try:
+        results = {}
+
+        def one(i):
+            prompt = [i % 7 + 1, i % 5 + 1]
+            try:
+                results[i] = _post(
+                    base, {"prompt_tokens": prompt, "max_tokens": 24},
+                    rid=f"e2e-{i}")
+            except Exception as e:  # noqa: BLE001 — asserted below
+                results[i] = (None, f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # ZERO failed requests, ids intact, tokens byte-identical to
+        # what the dead replica would have produced
+        for i, (code, body) in sorted(results.items()):
+            assert code == 200, f"request {i}: {code} {body}"
+            assert body["request_id"] == f"e2e-{i}"
+            assert body["generated"] == fake_tokens(
+                [i % 7 + 1, i % 5 + 1], 24)
+        # the fault plan really killed r0 (rc from os._exit)
+        deadline = time.monotonic() + 15
+        while sups[0].alive() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not sups[0].alive(), "fault plan did not kill r0"
+        r0 = router.replicas[0]
+        router.probe_now()
+        router.probe_now()
+        assert r0.breaker == "open"
+        assert router.registry.value(
+            "fdtpu_router_breaker_opens_total", "r0") >= 1
+        assert router.registry.value(
+            "fdtpu_router_failovers_total") >= 1
+        # fleet still green on the survivor
+        assert router.health()["ok"]
+
+        # recovery: the replica returns at its OLD port (no fault plan
+        # this time); breaker transitions back through half-open/probe
+        old_port = sups[0].port
+        sups[0].stop()
+        sups[0].argv = _fake_argv()
+        new_url = sups[0].spawn(port=old_port)
+        assert new_url == urls[0]
+        wait_http_ready(new_url + "/healthz", timeout=60)
+        time.sleep(0.25)  # past the breaker cooldown
+        router.probe_now()
+        assert r0.breaker == "closed" and r0.healthy
+        code, body = _post(base, {"prompt_tokens": [9, 9],
+                                  "max_tokens": 4}, rid="post-recovery")
+        assert code == 200
+        assert body["generated"] == fake_tokens([9, 9], 4)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        router.close()
+        for sup in sups:
+            sup.stop()
+
+
+@pytest.mark.slow
+def test_real_engine_rolling_restart_holds_one_decode_compile(tmp_path):
+    """Real lm_tiny replicas sharing one AOT executable pool: a rolling
+    restart under light load drops nothing, and every restarted replica
+    comes back having loaded its programs from the pool — the
+    ONE-decode-compile invariant (fdtpu_serve_decode_compiles == 1)
+    held across the redeploy."""
+    aot = str(tmp_path / "aot-pool")
+    argv = [sys.executable, SERVE, "--lm", "--model", "lm_tiny",
+            "--vocab", "256", "--max-slots", "2", "--max-len", "64",
+            "--buckets", "8,16", "--prewarm", "--aot-dir", aot,
+            "--port", "0"]
+    # sequential spawn ON PURPOSE: the first replica compiles and
+    # serializes the pool, the second (and every restart) loads it
+    sup0 = SupervisedReplica(argv, name="r0", env=ENV,
+                             startup_timeout=600.0, verbose=False)
+    url0 = sup0.spawn()
+    wait_http_ready(url0 + "/healthz", timeout=60)
+    sup1 = SupervisedReplica(argv, name="r1", env=ENV,
+                             startup_timeout=600.0, verbose=False)
+    url1 = sup1.spawn()
+    wait_http_ready(url1 + "/healthz", timeout=60)
+    router = Router(
+        [Replica("r0", url0, restart=sup0.restart),
+         Replica("r1", url1, restart=sup1.restart)],
+        probe_interval=3600.0, failure_threshold=2,
+        dispatch_backoff=0.02, upstream_timeout=300.0)
+    httpd = router.serve("127.0.0.1", 0)
+    threading.Thread(
+        target=lambda: httpd.serve_forever(poll_interval=0.02),
+        daemon=True).start()
+    base = f"http://127.0.0.1:{router.bound_port}"
+    try:
+        code, body = _post(base, {"prompt_tokens": [3, 1, 4],
+                                  "max_tokens": 6}, rid="warm-1")
+        assert code == 200 and len(body["generated"]) == 6
+        golden = body["generated"]
+
+        stop = threading.Event()
+        outcomes = []
+
+        def load():
+            i = 0
+            while not stop.is_set():
+                try:
+                    outcomes.append(_post(
+                        base, {"prompt_tokens": [3, 1, 4],
+                               "max_tokens": 6}, timeout=120))
+                except Exception as e:  # noqa: BLE001
+                    outcomes.append((None, f"{type(e).__name__}: {e}"))
+                i += 1
+                time.sleep(0.2)
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+        results = router.rolling_restart(drain_timeout=60.0,
+                                         ready_timeout=300.0)
+        stop.set()
+        t.join(timeout=30)
+        assert len(results) == 2
+        bad = [(c, b) for c, b in outcomes if c != 200]
+        assert not bad, f"rolling restart dropped requests: {bad[:3]}"
+        # parity across the restart (greedy determinism end-to-end)
+        assert all(b["generated"] == golden for c, b in outcomes)
+        # every restarted replica holds the ONE-decode-compile
+        # invariant live on /metrics: 0 compiles means the whole pool
+        # deserialized from the shared AOT dir (the restart was a LOAD
+        # — the point of riding compilation.py), 1 would be a fresh
+        # compile, anything more is the violation
+        for rep in router.replicas:
+            with urllib.request.urlopen(rep.url + "/metrics",
+                                        timeout=10) as r:
+                text = r.read().decode()
+            line = next(l for l in text.splitlines()
+                        if l.startswith("fdtpu_serve_decode_compiles "))
+            assert float(line.split()[1]) == 0.0, (
+                f"restarted replica {rep.name} recompiled instead of "
+                f"loading the AOT pool: {line}")
+        code, body = _post(base, {"prompt_tokens": [3, 1, 4],
+                                  "max_tokens": 6})
+        assert code == 200 and body["generated"] == golden
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        router.close()
+        sup0.stop()
+        sup1.stop()
