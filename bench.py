@@ -195,6 +195,28 @@ def mfu_pct(flops: float, dt: float, nchips: int):
     return round(flops / dt / nchips / (peak * 1e12) * 100, 2)
 
 
+def guard_stamp():
+    """The robustness-counter stamp for the bench JSON: every
+    ``fdtpu_guard_* / fdtpu_fault_* / fdtpu_watchdog_*`` series (plus
+    the OOM-skip counter) snapshotted from the process registry.  A
+    dead hardware round's artifact then records WHY it died — faults
+    injected/retried/given up, stalls and escalations, anomalies
+    quarantined — instead of a bare ``value: 0``.  Like
+    :func:`lint_stamp`, it never raises and rides success and error
+    JSON alike."""
+    try:
+        from fluxdistributed_tpu.obs import get_registry
+
+        snap = get_registry().snapshot()
+        keep = ("fdtpu_guard_", "fdtpu_fault_", "fdtpu_watchdog_",
+                "fdtpu_train_oom_skipped_total")
+        out = {k: v for k, v in snap.items()
+               if k.startswith(keep) and v}
+        return out or {"clean": True}
+    except Exception as e:  # noqa: BLE001 — stamp is best-effort
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def lint_stamp():
     """The static-health stamp for the bench JSON: the AST-layer
     rule-count summary + new-vs-baseline count from the fdtpu-lint suite
@@ -445,6 +467,7 @@ def resumable_main(argv=None) -> int:
                 "compile_cache_dir": cache_dir,
                 "aot_path": aot_path,
                 "lint": lint_stamp(),
+                "guard": guard_stamp(),
             }))
             return 0
 
@@ -471,6 +494,7 @@ def resumable_main(argv=None) -> int:
             "compile_seconds_saved": cm["compile_seconds_saved"],
             "compile_cache_dir": cache_dir,
             "lint": lint_stamp(),
+            "guard": guard_stamp(),
         }))
         return 0
     except BaseException as e:  # noqa: BLE001 — always emit the JSON line
@@ -491,6 +515,7 @@ def resumable_main(argv=None) -> int:
             "retryable": retryable_error(attempt["phase"], err),
             "resumable": provenance(),
             "lint": lint_stamp(),
+            "guard": guard_stamp(),
         }))
         return 0
 
@@ -505,7 +530,8 @@ def _write_status(path, phase):
     from fluxdistributed_tpu import compilation
 
     try:
-        payload = {"phase": phase, **compilation.compile_metrics()}
+        payload = {"phase": phase, **compilation.compile_metrics(),
+                   "guard": guard_stamp()}
         tmp = f"{path}.tmp"
         with open(tmp, "w") as f:
             json.dump(payload, f)
@@ -573,6 +599,9 @@ def _measure():
         "compile_cache_dir": cache_dir,
         # static-health stamp: the lint verdict this code measured under
         "lint": lint_stamp(),
+        # robustness forensics: fault/watchdog/guard counters this
+        # measurement accumulated (retries survived, stalls seen)
+        "guard": guard_stamp(),
     }
 
 
@@ -665,6 +694,9 @@ def main():
         # the error artifact carries the same static-health stamp, so a
         # timeout round still records whether the code was lint-clean
         "lint": lint_stamp(),
+        # the CHILD's robustness counters at its last status snapshot —
+        # a dead round records the faults/stalls it saw before dying
+        "guard": status.get("guard", guard_stamp()),
     }
     # If a background probe loop has been retrying the chip (the r4+
     # availability workflow: benchmarks/hw_watch.sh, docs/benchmarks.md),

@@ -206,6 +206,41 @@ def build_parser() -> argparse.ArgumentParser:
                         "to 503 when no step lands inside it; eval and "
                         "checkpoint phases are exempt). 0 disables the "
                         "watchdog")
+    p.add_argument("--watchdog-escalate", type=int, default=4, metavar="N",
+                   help="after a stall persists N further threshold "
+                        "windows with no step, count a watchdog "
+                        "ESCALATION (fdtpu_watchdog_escalations_total) — "
+                        "the wedged-collective signal bin/supervise.py "
+                        "SIGKILLs on. 0 disables escalation")
+    # self-healing guard (fluxdistributed_tpu/train/guard.py)
+    p.add_argument("--guard", action="store_true",
+                   help="self-healing training: compile the anomaly "
+                        "sentinel into the train step (global isfinite "
+                        "any-reduce over loss+grads and global grad-norm, "
+                        "ONE extra scalar fetch per step) and arm the "
+                        "policy ladder — quarantine-and-skip anomalous "
+                        "batches, roll back to the last-good checkpoint "
+                        "when anomalies persist, halt (exit rc 65, not "
+                        "retryable) when rollbacks loop.  Decisions are "
+                        "recorded in the RESUME manifest and visible as "
+                        "fdtpu_guard_* metrics")
+    p.add_argument("--guard-zmax", type=float, default=8.0,
+                   help="robust z-score above which a finite loss counts "
+                        "as a spike anomaly")
+    p.add_argument("--guard-window", type=int, default=64,
+                   help="rolling window (accepted losses) behind the "
+                        "spike detector's median/MAD")
+    p.add_argument("--guard-rollback-after", type=int, default=3,
+                   help="anomalies within the guard's anomaly window "
+                        "that escalate skip -> rollback")
+    p.add_argument("--replay-step", type=int, default=None, metavar="K",
+                   help="diagnosis harness: instead of training, "
+                        "re-execute loader item K deterministically "
+                        "(same (seed, process, item) batch derivation) "
+                        "against the prepared — or, with --resume, the "
+                        "restored — state under jax_debug_nans, print "
+                        "one JSON report line and exit.  The postmortem "
+                        "for a quarantined step")
     p.add_argument("--fault-plan", default=None, metavar="JSON",
                    help="install a deterministic fault-injection plan "
                         "(fluxdistributed_tpu.faults) before anything "
@@ -499,6 +534,16 @@ def main(argv=None) -> int:
             f"{jax.devices()[0].platform}, mesh {dict(mesh.shape)}"
         )
 
+    # the compiled grad sentinel rides dp.make_train_step; other modes
+    # still get the guard POLICY loss-only (non-finite loss + spikes),
+    # so --guard degrades instead of erroring there
+    guard_sentinel = args.guard and args.spmd in ("jit", "dp", "sp",
+                                                  "ep", "pp")
+    if args.guard and not guard_sentinel and multihost.is_coordinator():
+        print(f"guard: spmd={args.spmd} has no compiled grad sentinel — "
+              "running loss-only (non-finite loss + spike detection; "
+              "gradient blow-ups that keep the loss finite pass unseen)")
+
     task = prepare_training(
         model, dataset, opt,
         mesh=mesh,
@@ -513,6 +558,7 @@ def main(argv=None) -> int:
         aot=args.aot,
         warmup=args.prewarm,
         strict_checks=args.strict_checks,
+        guard=guard_sentinel,
         **lm_extra,
     )
 
@@ -526,6 +572,17 @@ def main(argv=None) -> int:
                    else "latest checkpoint (no manifest)")
             print(f"resumed from step {int(task.state.step)} at item "
                   f"{getattr(task.loader, 'start', 0)} via {src}")
+
+    if args.replay_step is not None:
+        import json as json_lib
+
+        from fluxdistributed_tpu.train import replay_item
+
+        # one quarantined step, re-executed from checkpoint + cursor
+        # for diagnosis — never trains, never mutates the state
+        report = replay_item(task, args.replay_step)
+        print(json_lib.dumps(report))
+        return 0
 
     if args.wandb:
         from fluxdistributed_tpu.train.logging import WandbLogger
@@ -558,7 +615,8 @@ def main(argv=None) -> int:
 
     observation = Observation(
         tracer=SpanTracer() if args.trace_events else None,
-        watchdog=(StepWatchdog(factor=args.watchdog_factor)
+        watchdog=(StepWatchdog(factor=args.watchdog_factor,
+                               escalate_after=args.watchdog_escalate)
                   if args.watchdog_factor else None),
         trace_path=args.trace_events,
         device_sync=bool(args.trace_events),
@@ -578,12 +636,27 @@ def main(argv=None) -> int:
                 "compiles": reg.value("fdtpu_jax_compiles_total"),
                 "steady_recompiles": reg.value(
                     "fdtpu_jax_steady_recompiles_total"),
+                "escalations": reg.value(
+                    "fdtpu_watchdog_escalations_total"),
+                "quarantined": reg.value("fdtpu_guard_quarantine_size"),
             }
 
         metrics_srv = start_metrics_server(
             port=args.metrics_port, health_fn=_health)
         print(f"metrics: http://0.0.0.0:{metrics_srv.port}/metrics "
               f"(+ /healthz)")
+
+    guard_cfg = None
+    if args.guard:
+        from fluxdistributed_tpu.train import GuardConfig
+
+        guard_cfg = GuardConfig(
+            zmax=args.guard_zmax,
+            window=args.guard_window,
+            rollback_after=args.guard_rollback_after,
+        )
+
+    from fluxdistributed_tpu.train import GuardHalt
 
     try:
         train(
@@ -597,7 +670,15 @@ def main(argv=None) -> int:
             verbose=args.verbose,
             observation=observation,
             handle_signals=True,
+            guard=guard_cfg,
         )
+    except GuardHalt as e:
+        # recovery is looping: a DISTINCT, deliberately NON-retryable
+        # exit code — a supervisor must page a human, not requeue
+        if multihost.is_coordinator():
+            print(f"guard halt: {e} (exit code {faults.HALTED_RC}, "
+                  "retryable: false)")
+        return faults.HALTED_RC
     except faults.Preempted as e:
         # checkpoint + RESUME manifest are already durably on disk;
         # the DISTINCT exit code tells a supervisor "requeue me with

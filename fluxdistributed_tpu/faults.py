@@ -41,9 +41,11 @@ from typing import Any, Callable, Dict, List, Optional
 
 __all__ = [
     "FAULT_ACTIONS",
+    "HALTED_RC",
     "HANG_DELAY_SECONDS",
     "PREEMPTED_RC",
     "UNAVAILABLE_SIGNATURES",
+    "VALUE_ACTIONS",
     "BackendUnavailable",
     "FaultInjected",
     "FaultPlan",
@@ -54,6 +56,7 @@ __all__ = [
     "active_plan",
     "clear_plan",
     "fire",
+    "fire_value",
     "install_plan",
     "param",
     "record_preemption",
@@ -65,6 +68,13 @@ __all__ = [
 #: success (0) and a crash (1/tracebacks): a supervisor that sees it
 #: should requeue the run with ``--resume``.
 PREEMPTED_RC = 75
+
+#: exit code of a driver run the training guard HALTED (rollback loop:
+#: anomalies recur faster than checkpoints make progress) — EX_DATAERR,
+#: "the input data was incorrect".  Deliberately NOT retryable: a
+#: supervisor that sees it must page a human instead of requeueing a
+#: run that provably cannot make progress (``train/guard.py``).
+HALTED_RC = 65
 
 
 class FaultInjected(RuntimeError):
@@ -138,8 +148,19 @@ def _metrics():
 #: ``exit`` is a replica crash (``os._exit`` — no drain, no atexit, the
 #: SIGKILL shape), ``sleep`` is a slow replica (delay then continue),
 #: ``hang`` is a wedged one (delay defaults to an hour — the caller's
-#: timeout machinery is what's under test).
-FAULT_ACTIONS = ("raise", "sigterm", "sigint", "exit", "sleep", "hang")
+#: timeout machinery is what's under test).  ``nan``/``inf`` are VALUE
+#: corruptions: they only trigger at :func:`fire_value` sites (the
+#: training guard's ``train.loss``/``train.grad`` sentinel taps) and
+#: replace the observed value instead of raising — the RNG-free way to
+#: prove every anomaly-detection path on a CPU dev box.
+FAULT_ACTIONS = ("raise", "sigterm", "sigint", "exit", "sleep", "hang",
+                 "nan", "inf")
+
+#: the subset of :data:`FAULT_ACTIONS` that corrupts an observed value
+#: rather than performing a side effect; matched only by
+#: :func:`fire_value` (plain :func:`fire` skips them — a value
+#: corruption without a value to corrupt is meaningless).
+VALUE_ACTIONS = ("nan", "inf")
 
 #: how long a "hang" action sleeps when no explicit delay is given —
 #: far beyond any probe/dispatch/request timeout in the tree
@@ -181,7 +202,14 @@ class FaultPlan:
       attempt (retried across replicas by ``with_retries``);
     * ``"serve.probe"`` — inside the router's health-probe attempt
       (``index`` = the running probe count; failures feed the circuit
-      breaker without any real outage).
+      breaker without any real outage);
+    * ``"train.loss"`` / ``"train.grad"`` — VALUE sites inside the
+      training guard's sentinel read (``fire_value(site, value,
+      index=j)`` with the loader-item index): a ``nan``/``inf`` action
+      replaces the observed loss / global grad-norm component, so every
+      anomaly-detection + quarantine + rollback path is provable
+      deterministically, RNG-free, with zero recompiles
+      (``train/guard.py``).
 
     ``params`` is a free-form dict for harness knobs that are not
     exceptions — e.g. ``{"local_devices": 4}`` makes ``bin/driver.py``
@@ -255,6 +283,13 @@ class FaultPlan:
                       {"site": "serve.dispatch", "times": 2},
                       {"site": "serve.probe", "action": "sleep",
                        "delay": 0.5}]}
+
+        — and the training-guard surface: ``nan``/``inf`` value
+        corruptions at the sentinel sites, a deterministic step-k
+        anomaly with no RNG and no recompile::
+
+            {"fail": [{"site": "train.loss", "at": 2, "action": "nan"},
+                      {"site": "train.grad", "at": 5, "action": "inf"}]}
         """
         plan = cls()
         known = {"sigterm_at_step", "sigint_at_step", "loader_fail",
@@ -302,7 +337,9 @@ class FaultPlan:
         ``exit`` is an immediate hard kill (``os._exit`` — a crash, not
         a drain); ``sleep``/``hang`` stall the CALLING thread for the
         fault's delay and then return (the slow/wedged-replica shapes —
-        everything else in the process keeps running)."""
+        everything else in the process keeps running).  Value actions
+        (``nan``/``inf``) never match here — they need a value to
+        corrupt and only trigger at :meth:`fire_value` sites."""
         to_signal = None
         exc: Optional[BaseException] = None
         hard_exit = False
@@ -312,6 +349,8 @@ class FaultPlan:
                 if f.site != site or f.fired >= f.times:
                     continue
                 if f.at is not None and index != f.at:
+                    continue
+                if f.action in VALUE_ACTIONS:
                     continue
                 f.fired += 1
                 _metrics()["injected"].labels(site=site).inc()
@@ -342,6 +381,27 @@ class FaultPlan:
         if exc is not None:
             raise exc
 
+    def fire_value(self, site: str, value: float,
+                   index: Optional[int] = None) -> float:
+        """Value-corruption delivery: side-effect actions at ``site``
+        run first (via :meth:`fire` — a ``raise``/``hang`` planted on a
+        sentinel site still behaves), then the first matching
+        ``nan``/``inf`` action replaces ``value``.  With no match the
+        value passes through untouched."""
+        self.fire(site, index)
+        with self._lock:
+            for f in self._faults:
+                if f.site != site or f.fired >= f.times:
+                    continue
+                if f.at is not None and index != f.at:
+                    continue
+                if f.action not in VALUE_ACTIONS:
+                    continue
+                f.fired += 1
+                _metrics()["injected"].labels(site=site).inc()
+                return float("nan") if f.action == "nan" else float("inf")
+        return value
+
 
 _PLAN: Optional[FaultPlan] = None
 
@@ -367,6 +427,15 @@ def fire(site: str, index: Optional[int] = None) -> None:
     plan is installed."""
     if _PLAN is not None:
         _PLAN.fire(site, index)
+
+
+def fire_value(site: str, value: float, index: Optional[int] = None) -> float:
+    """Hot-path VALUE hook (the guard's sentinel taps): returns
+    ``value`` untouched unless the active plan plants a ``nan``/``inf``
+    corruption at ``site`` — one global load + None check when idle."""
+    if _PLAN is not None:
+        return _PLAN.fire_value(site, value, index)
+    return value
 
 
 def param(name: str, default: Any = None) -> Any:

@@ -15,6 +15,12 @@ trainer's phase brackets register there even without a tracer), so an
 episode says "stalled in 'dispatch'" instead of just "stalled";
 :attr:`last_where` keeps it readable for ``on_stall`` callbacks.
 
+A stall that persists ``escalate_after`` further threshold windows
+fires ONE **escalation** (``fdtpu_watchdog_escalations_total`` + the
+``on_escalate`` abort callback): the warn says "slow", the escalation
+says "wedged for good" — the signal ``bin/supervise.py`` SIGKILLs and
+elastically resumes on.
+
 The existing OOM-skip counter folds in through :meth:`note_skip`: a
 skipped batch both keeps the heartbeat alive (the loop IS making
 progress) and increments ``fdtpu_train_oom_skipped_total`` — one place
@@ -55,6 +61,17 @@ class StepWatchdog:
         compiles and are not cadence)
     on_stall: ``fn(elapsed_sec, threshold_sec)`` — defaults to a stderr
         warning; fired ONCE per stall episode (a beat re-arms it)
+    escalate_after: a stall that persists this many FURTHER threshold
+        windows (i.e. ``elapsed > (1 + escalate_after) × threshold``)
+        counts an ESCALATION — ``fdtpu_watchdog_escalations_total``
+        increments and ``on_escalate`` fires, once per stall (a beat
+        re-arms).  This is the wedged-collective signal: a one-off warn
+        says "slow", the escalation says "this loop is never coming
+        back" — the counter a supervisor (``bin/supervise.py``)
+        SIGKILLs on.  0 (default) preserves the warn-once behavior.
+    on_escalate: ``fn(elapsed_sec, threshold_sec)`` abort callback run
+        at escalation — e.g. dump state and ``os._exit``; default is a
+        stderr warning (the counter alone is the remote signal)
     registry: metrics registry (default: the process registry)
     """
 
@@ -66,22 +83,30 @@ class StepWatchdog:
         check_every: float = 0.5,
         warmup: int = 3,
         on_stall: Optional[Callable[[float, float], None]] = None,
+        escalate_after: int = 0,
+        on_escalate: Optional[Callable[[float, float], None]] = None,
         registry: Optional[Registry] = None,
         name_prefix: str = "fdtpu",
     ):
         if factor <= 1.0:
             raise ValueError(f"factor must be > 1, got {factor}")
+        if escalate_after < 0:
+            raise ValueError(
+                f"escalate_after must be >= 0, got {escalate_after}")
         self.factor = factor
         self.min_interval = min_interval
         self.check_every = check_every
         self.warmup = warmup
         self.on_stall = on_stall
+        self.escalate_after = escalate_after
+        self.on_escalate = on_escalate
         self.registry = registry or get_registry()
         self._intervals: deque = deque(maxlen=window)
         self._lock = threading.Lock()
         self._last_beat: Optional[float] = None
         self._beats = 0
         self._fired = False  # one warning per stall episode
+        self._escalated = False  # one escalation per stall episode
         self._paused = 0  # pause() nesting depth
         # the beat ending a pause-containing iteration measures only the
         # post-pause remainder — a bogus near-zero interval that would
@@ -101,6 +126,11 @@ class StepWatchdog:
             f"{name_prefix}_train_oom_skipped_total",
             "batches skipped by OOM fault tolerance",
         )
+        self._escalations = self.registry.counter(
+            f"{name_prefix}_watchdog_escalations_total",
+            "stalls that persisted past escalate_after further threshold "
+            "windows (the wedged-collective signal supervisors kill on)",
+        )
         self._stalled.set(0)
         #: innermost active span/phase at the most recent stall fire
         #: (None when nothing was bracketed) — set BEFORE on_stall runs
@@ -118,6 +148,7 @@ class StepWatchdog:
             self._beats += 1
             if self._fired:
                 self._fired = False
+                self._escalated = False
                 self._stalled.set(0)
 
     def note_skip(self, n: int = 1) -> None:
@@ -162,15 +193,21 @@ class StepWatchdog:
     def poll(self, now: Optional[float] = None) -> bool:
         """One check; returns True iff a NEW stall episode fired.
         (Public so tests — or a caller without threads — drive it
-        synchronously.)"""
+        synchronously.)  A stall that persists ``escalate_after``
+        further threshold windows additionally fires ONE escalation —
+        without it a permanent stall would warn once and then sit
+        silent forever, indistinguishable from a slow phase."""
         thr = self.threshold()
         with self._lock:
             last = self._last_beat
             already = self._fired
             paused = self._paused > 0
-        if thr is None or last is None or already or paused:
+        if thr is None or last is None or paused:
             return False
         elapsed = (now if now is not None else time.monotonic()) - last
+        if already:
+            self._maybe_escalate(elapsed, thr)
+            return False
         if elapsed <= thr:
             return False
         with self._lock:
@@ -195,6 +232,32 @@ class StepWatchdog:
                 file=sys.stderr,
             )
         return True
+
+    def _maybe_escalate(self, elapsed: float, thr: float) -> None:
+        if not self.escalate_after or elapsed <= thr * (
+                1 + self.escalate_after):
+            return
+        with self._lock:
+            if self._escalated or not self._fired:
+                return
+            self._escalated = True
+        from .spans import innermost_active
+
+        self.last_where = innermost_active()
+        where = (f" inside span/phase {self.last_where!r}"
+                 if self.last_where else "")
+        self._escalations.inc()
+        if self.on_escalate is not None:
+            self.on_escalate(elapsed, thr)
+        else:
+            print(
+                f"obs.watchdog: ESCALATION — the stall{where} has "
+                f"persisted {elapsed:.1f}s (> {1 + self.escalate_after} x "
+                f"the {thr:.1f}s threshold); this loop is likely wedged "
+                "for good (hung collective / dead backend) — a "
+                "supervisor should SIGKILL and resume elastically",
+                file=sys.stderr,
+            )
 
     def _run(self) -> None:
         while not self._stop.wait(self.check_every):

@@ -44,7 +44,8 @@ from . import collectives
 
 Pytree = Any
 
-__all__ = ["TrainState", "make_train_step", "make_eval_step", "make_train_step_shardmap"]
+__all__ = ["TrainState", "guard_sentinel", "make_train_step",
+           "make_eval_step", "make_train_step_shardmap"]
 
 
 @struct.dataclass
@@ -116,6 +117,31 @@ def flax_loss_fn(model, loss, has_aux_state: bool = True) -> Callable:
     return fn
 
 
+def guard_sentinel(loss, grads):
+    """The in-graph anomaly sentinel (``train/guard.py``): a length-2
+    f32 vector ``[poisoned_loss, grad_norm]`` computed where the
+    gradients already live, so detecting a bad step costs ONE extra
+    device->host scalar fetch and zero extra compiles.
+
+    * ``grad_norm`` — global L2 norm over every gradient leaf (f32
+      accumulation).  A NaN anywhere poisons it to NaN; an Inf (or an
+      f32-overflowing explosion) drives it to Inf — the global
+      ``isfinite`` any-reduce over the gradients, folded into a number
+      that is also the magnitude signal.
+    * ``poisoned_loss`` — the step loss plus ``0 * grad_norm``: equal
+      to the loss bit-for-bit when the gradients are finite (the
+      loss-spike detector's input), NaN whenever loss or any gradient
+      is not (``0 * inf`` and ``0 * nan`` are both NaN) — loss AND
+      gradient finiteness any-reduced into one scalar.
+    """
+    gsq = jnp.float32(0.0)
+    for g in jax.tree.leaves(grads):
+        gsq = gsq + jnp.sum(jnp.square(g.astype(jnp.float32)))
+    gnorm = jnp.sqrt(gsq)
+    return jnp.stack(
+        [jnp.asarray(loss, jnp.float32) + 0.0 * gnorm, gnorm])
+
+
 def make_train_step(
     loss_fn: Callable,
     optimizer: Optimizer,
@@ -126,6 +152,7 @@ def make_train_step(
     seed: int = 0,
     state_shardings=None,
     steps_per_call: int = 1,
+    guard: bool = False,
 ):
     """Compile the full DP training step under ``jit`` + shardings.
 
@@ -161,6 +188,13 @@ def make_train_step(
     batch — semantics identical to K separate calls — but the host pays
     one dispatch instead of K, which matters when dispatch crosses a
     network tunnel or the host is slow relative to the step.
+
+    ``guard=True`` adds ``metrics["guard"]`` — the
+    :func:`guard_sentinel` ``[poisoned_loss, grad_norm]`` vector (per
+    step; stacked ``[K, 2]`` under the device loop), computed in-graph
+    from the same gradients the update consumes.  It changes nothing
+    about the update math; the trainer's guard policy engine fetches it
+    once per step to detect non-finite grads/loss and loss spikes.
     """
     repl = NamedSharding(mesh, P())
     # axis=None: batch replicated (e.g. a pure 'expert' mesh where the
@@ -215,7 +249,10 @@ def make_train_step(
             model_state=new_mstate,
             step=state.step + 1,
         )
-        return new_state, {"loss": loss}
+        metrics = {"loss": loss}
+        if guard:
+            metrics["guard"] = guard_sentinel(loss, grads)
+        return new_state, metrics
 
     if steps_per_call == 1:
         return jax.jit(

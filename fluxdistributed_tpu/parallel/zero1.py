@@ -245,13 +245,14 @@ def make_train_step_zero1(
     accum_steps: int = 1,
     seed: int = 0,
     steps_per_call: int = 1,
+    guard: bool = False,
 ):
     """The DP train step with a ZeRO-1 sharded weight update (GSPMD).
 
     Identical loss/gradient math to ``dp.make_train_step`` — the wrapped
     optimizer changes only the update's data layout, so every DP feature
     (gradient accumulation, the scan-K device loop, donation, OOM-skip
-    at the trainer) composes unchanged.  ``shardings`` is the tree from
+    at the trainer, the ``guard`` anomaly sentinel) composes unchanged.  ``shardings`` is the tree from
     :func:`zero1_state` and is REQUIRED: compiling without it would fall
     back to dp's replicated default, which silently re-replicates the
     optimizer state on the first step — the exact redundancy ZeRO-1
@@ -268,6 +269,7 @@ def make_train_step_zero1(
         loss_fn, z, mesh,
         axis=axis, donate=donate, accum_steps=accum_steps, seed=seed,
         state_shardings=shardings, steps_per_call=steps_per_call,
+        guard=guard,
     )
 
 

@@ -17,6 +17,7 @@ from .checkpoint import (
     wait_for_pending,
     write_resume_manifest,
 )
+from .guard import GuardConfig, GuardHalt, TrainGuard, replay_item
 from .model_selection import (
     SelectionTask,
     prepare_model_selection,
@@ -24,6 +25,10 @@ from .model_selection import (
 )
 
 __all__ = [
+    "GuardConfig",
+    "GuardHalt",
+    "TrainGuard",
+    "replay_item",
     "ConsoleLogger",
     "Logger",
     "NullLogger",

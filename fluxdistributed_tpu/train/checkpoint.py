@@ -22,8 +22,10 @@ no resume path (SURVEY §5).  This module closes that gap TPU-natively:
 * ``latest_step`` — scan a checkpoint dir;
 * ``write_resume_manifest`` / ``read_resume_manifest`` — the RESUME
   manifest a preempted run leaves next to its checkpoint (step,
-  data-loader cursor, rng derivation note, mesh topology) so the next
-  process can continue step-for-step identically.
+  data-loader cursor, rng derivation note, mesh topology, and — for
+  guarded runs — the ``quarantined_items`` the anomaly guard decided
+  to skip, see ``train/guard.py``) so the next process can continue
+  step-for-step identically, re-skipping the same batches.
 
 Orbax handles sharded arrays natively, so the same call works on a
 multi-host pod slice (each host writes its addressable shards).

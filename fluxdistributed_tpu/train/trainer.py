@@ -44,6 +44,7 @@ from ..obs import Observation, jaxmon
 from ..ops import logitcrossentropy
 from ..optim import Optimizer
 from ..parallel.dp import TrainState, flax_loss_fn, make_eval_step, make_train_step
+from .guard import state_donated
 from .logging import Logger, current_logger
 
 __all__ = ["TrainTask", "evaluate", "prepare_training", "train"]
@@ -81,6 +82,11 @@ class TrainTask:
     # RESUME manifest carries them) and postmortems can name the lost
     # batches by global index
     skipped_items: list = dataclasses.field(default_factory=list)
+    # loader-item indices quarantined by the anomaly guard (train/guard
+    # .py) — restored from the RESUME manifest by resume_training so a
+    # resumed/rolled-back run deterministically re-skips the same
+    # batches (the loss-parity contract extends to guard decisions)
+    quarantined_items: list = dataclasses.field(default_factory=list)
     # the top-k metrics compiled into eval_fn; ``train`` reports these
     # by default so a mode that compiles loss-only eval (the LM
     # pipelines) needs no caller-side coordination
@@ -134,6 +140,7 @@ def prepare_training(
     aot: Optional[str] = None,
     warmup: bool = False,
     strict_checks: bool = False,
+    guard: bool = False,
 ) -> TrainTask:
     """Initialize params, compile the SPMD step, build prefetch loaders.
 
@@ -203,6 +210,19 @@ def prepare_training(
       returning, so the first ``train`` step — and anything timing it —
       starts warm.
 
+    ``guard=True`` compiles the anomaly sentinel into the train step
+    (``parallel.dp.guard_sentinel``: ``metrics["guard"] =
+    [poisoned_loss, grad_norm]``, the global isfinite any-reduce over
+    loss + grads plus the global grad norm, in-graph where the
+    gradients already live) so ``train(guard=GuardConfig(...))`` can
+    detect bad steps at ONE extra scalar fetch per step and zero extra
+    compiles.  Supported on the paths that ride
+    ``dp.make_train_step`` — ``jit``/``dp`` (with or without
+    ``zero1``), ``sp``, ``ep`` and the GPipe ``pp`` — and requires
+    ``donate=False``: recovery re-uses the pre-step state, exactly like
+    OOM-skip.  Other modes still run the guard loss-only (non-finite
+    loss + spikes) without this flag.
+
     ``strict_checks=True`` arms the returned step/eval functions for
     their first TWO invocations: call 1 runs with ``jax_debug_nans`` on
     (a NaN/Inf in the outputs raises and jax re-runs op-by-op to name
@@ -235,6 +255,20 @@ def prepare_training(
             f"'shard_map'); got spmd={spmd!r} — fsdp already shards the "
             "optimizer state (ZeRO-3 subsumes ZeRO-1)"
         )
+    if guard:
+        if donate:
+            raise ValueError(
+                "guard=True requires donate=False: anomaly recovery "
+                "discards the poisoned step and continues from the "
+                "PRE-step state, which donation would have freed "
+                "(the same contract as OOM-skip)")
+        if spmd not in ("jit", "sp", "ep", "pp"):
+            raise ValueError(
+                f"guard=True compiles the grad sentinel into "
+                f"dp.make_train_step, which spmd={spmd!r} does not use "
+                "(supported: jit/dp [+zero1], sp, ep, pp) — the guard "
+                "still runs loss-only there: drop guard=True and pass "
+                "train(guard=GuardConfig(...))")
     if num_microbatches is not None and spmd not in ("pp", "pp_1f1b"):
         raise ValueError("num_microbatches requires spmd='pp' or 'pp_1f1b'")
     if pipeline_interleave and spmd != "pp_1f1b":
@@ -432,7 +466,7 @@ def prepare_training(
             if spmd == "pp":
                 step_fn = make_train_step(
                     pp_loss_fn, optimizer, mesh, axis=mesh_lib.DATA_AXIS,
-                    donate=donate, state_shardings=sh,
+                    donate=donate, state_shardings=sh, guard=guard,
                 )
             else:
                 w = lm_pp_1f1b(model, mesh)
@@ -477,7 +511,7 @@ def prepare_training(
         state = jax.tree.map(jax.device_put, state, sh)
         step_fn = make_train_step(
             loss_fn, optimizer, mesh, axis=mesh_lib.DATA_AXIS,
-            donate=donate, seed=seed, state_shardings=sh,
+            donate=donate, seed=seed, state_shardings=sh, guard=guard,
         )
         eval_fn = make_eval_step(loss_fn, mesh, topk=(), state_shardings=sh)
     elif spmd == "fsdp":
@@ -529,7 +563,7 @@ def prepare_training(
                 step_fn = zero1_lib.make_train_step_zero1(
                     loss_fn, optimizer, mesh, z_sh,
                     donate=donate, accum_steps=accum_steps, seed=seed,
-                    steps_per_call=steps_per_call,
+                    steps_per_call=steps_per_call, guard=guard,
                 )
             eval_fn = make_eval_step(
                 loss_fn, mesh, topk=tuple(topk), state_shardings=z_sh
@@ -543,7 +577,7 @@ def prepare_training(
                 step_fn = make_train_step(
                     loss_fn, optimizer, mesh,
                     donate=donate, accum_steps=accum_steps, seed=seed,
-                    steps_per_call=steps_per_call,
+                    steps_per_call=steps_per_call, guard=guard,
                 )
             eval_fn = make_eval_step(loss_fn, mesh, topk=tuple(topk))
 
@@ -614,10 +648,15 @@ def prepare_training(
             # name + closure constants, address-free).  Argument
             # shapes/shardings are the signature's job inside
             # load_or_compile
+            # "guard" appended only when on: the sentinel adds outputs
+            # to the compiled program, so a guarded step must never
+            # load an unguarded executable (or vice versa) — while
+            # guard-off runs keep their pre-existing tags byte-for-byte
             tag = compilation.config_tag(
                 spmd, zero1, accum_steps, steps_per_call, donate, seed,
                 num_microbatches, pipeline_interleave, repr(model),
-                optimizer.name, optimizer.update, loss_fn, loss)
+                optimizer.name, optimizer.update, loss_fn, loss,
+                *(("guard",) if guard else ()))
             task.step_fn = compilation.load_or_compile(
                 task.step_fn, (task.state, dummy),
                 directory=aot, name="train_step",
@@ -831,6 +870,10 @@ def resume_training(
         task.loader.start = int(manifest.get("next_item", 0))
         task.num_missed = int(manifest.get("num_missed", 0))
         task.skipped_items = list(manifest.get("skipped_items", []))
+        # guard decisions survive the process: a resumed run re-skips
+        # the quarantined batches (train() seeds its TrainGuard here)
+        task.quarantined_items = [
+            int(x) for x in manifest.get("quarantined_items", [])]
     else:
         # no manifest (a cadence checkpoint from an old-style run):
         # the step counter is the only cursor — correct when nothing
@@ -1059,6 +1102,7 @@ def train(
     profile_steps: int = 5,
     observation: Optional[Observation] = None,
     handle_signals: bool = False,
+    guard=None,
 ):
     """The training loop (``train`` src/ddp_tasks.jl:174-247).
 
@@ -1096,6 +1140,28 @@ def train(
     continues with step-for-step identical losses.  On multi-host runs
     the flag is agreed via :func:`..parallel.multihost.agree_to_stop`
     each step, so every host checkpoints at the same boundary.
+
+    ``guard`` (a :class:`.guard.GuardConfig`, or ``True`` for the
+    defaults) arms the self-healing policy engine
+    (:class:`.guard.TrainGuard`): each step's sentinel —
+    ``metrics["guard"]`` when the step was compiled with
+    ``prepare_training(guard=True)``, the loss otherwise — is checked
+    BEFORE the new state is committed, and the guard's verdict runs
+    the ladder: quarantine-and-skip the anomalous batch (the pre-step
+    state continues, exactly the OOM-skip recovery contract), roll back
+    to the last-good checkpoint with the cursor rewound and the
+    quarantined span recorded in the RESUME manifest, or raise
+    :class:`.guard.GuardHalt` when rollbacks loop without progress.
+    With a ``checkpoint_dir``, the starting state is banked as a
+    baseline checkpoint (so rollback always has a target, on the
+    CURRENT topology even after an elastic resume), cadence
+    checkpoints become blocking and each one refreshes the manifest —
+    a SIGKILL at ANY point resumes onto a consistent
+    (checkpoint, cursor, quarantine) triple.  Items in
+    ``task.quarantined_items`` (a resumed run's manifest) or
+    ``GuardConfig.quarantine`` are skipped before dispatch — which is
+    also how a clean run deterministically skips the batches a guarded
+    run quarantined, the loss-parity oracle the guard tests pin.
 
     Resume cursor: the loop starts at ``task.loader.start`` (0 for a
     fresh run; :func:`resume_training` sets it from the manifest), and
@@ -1161,6 +1227,80 @@ def train(
     wd_pause = (obs.watchdog.pause if obs.watchdog is not None
                 else contextlib.nullcontext)
 
+    # -- self-healing guard (train/guard.py) ---------------------------
+    guard_obj = None
+    if guard is not None and guard is not False:
+        from .guard import GuardConfig, TrainGuard
+
+        cfg = guard if isinstance(guard, GuardConfig) else GuardConfig()
+        guard_obj = TrainGuard(cfg, registry=reg, logger=logger)
+        # decisions recorded by a previous process (the RESUME manifest
+        # resume_training read) replay deterministically
+        for q in getattr(task, "quarantined_items", []):
+            if not guard_obj.is_quarantined(q):
+                guard_obj.quarantine(q)
+    # the rollback target: the newest checkpoint and the loader item a
+    # resume from it must start at — kept consistent with what is ON
+    # DISK (only ever updated after a blocking save)
+    last_good: Optional[dict] = None
+
+    def _run_manifest(reason: str, checkpoint_step: int,
+                      next_item: int) -> dict:
+        m = {
+            "version": 1,
+            "reason": reason,
+            "checkpoint_step": int(checkpoint_step),
+            "next_item": int(next_item),
+            "steps_per_call": spc,
+            "num_missed": int(task.num_missed),
+            "skipped_items": [int(x) for x in task.skipped_items],
+            "mesh": {k: int(v) for k, v in dict(task.mesh.shape).items()},
+            "device_count": jax.device_count(),
+            "process_count": jax.process_count(),
+            # how the two rng streams re-derive on resume — both are
+            # keyed on restored values, so no rng state needs saving
+            "rng": {
+                "step": "fold_in(PRNGKey(seed), state.step), in-graph",
+                "loader": "np.random.default_rng((seed, process, item))",
+            },
+        }
+        if guard_obj is not None:
+            m["quarantined_items"] = guard_obj.quarantined_items()
+        return m
+
+    def _write_guard_manifest() -> None:
+        """Persist the guard's (checkpoint, cursor, quarantine) triple
+        eagerly: a SIGKILL after a quarantine/rollback decision must
+        resume onto the SAME decision, not re-derive the cursor from a
+        step counter the skips have desynchronized."""
+        if guard_obj is None or not checkpoint_dir or last_good is None:
+            return
+        from .checkpoint import write_resume_manifest
+
+        write_resume_manifest(
+            checkpoint_dir,
+            _run_manifest("guard", last_good["step"], last_good["item"]))
+
+    if guard_obj is not None and checkpoint_dir:
+        from .checkpoint import save_checkpoint
+
+        # bank the starting state as the first last-good checkpoint:
+        # rollback needs a target from item 0 on, and re-saving on a
+        # RESUMED run keeps the target on the CURRENT topology (after
+        # an elastic resume, the previous run's checkpoint has the old
+        # device count's ZeRO-1 flat-pad layout — rolling back onto it
+        # would need the elastic path; re-banking makes every rollback
+        # a plain same-topology restore)
+        with wd_pause(), phases("checkpoint"):
+            known = int(task.state.step)
+            save_checkpoint(task.state, checkpoint_dir, known, block=True)
+        last_good = {"step": known, "item": start_item}
+        _write_guard_manifest()
+    elif guard_obj is not None:
+        logger.info(
+            "guard: no checkpoint_dir — the rollback tier is disabled, "
+            "the policy ladder is skip-and-quarantine -> halt")
+
     def _preempted() -> bool:
         if preempt is None or not handle_signals:
             return False
@@ -1178,24 +1318,9 @@ def train(
         from .checkpoint import save_checkpoint, write_resume_manifest
 
         step_now = int(task.state.step)
-        manifest = {
-            "version": 1,
-            "reason": preempt.reason if preempt is not None else "requested",
-            "checkpoint_step": step_now,
-            "next_item": j,
-            "steps_per_call": spc,
-            "num_missed": int(task.num_missed),
-            "skipped_items": [int(x) for x in task.skipped_items],
-            "mesh": {k: int(v) for k, v in dict(task.mesh.shape).items()},
-            "device_count": jax.device_count(),
-            "process_count": jax.process_count(),
-            # how the two rng streams re-derive on resume — both are
-            # keyed on restored values, so no rng state needs saving
-            "rng": {
-                "step": "fold_in(PRNGKey(seed), state.step), in-graph",
-                "loader": "np.random.default_rng((seed, process, item))",
-            },
-        }
+        manifest = _run_manifest(
+            preempt.reason if preempt is not None else "requested",
+            step_now, j)
         if checkpoint_dir:
             with wd_pause(), phases("checkpoint"):
                 # blocking: the process is about to exit — an async
@@ -1276,54 +1401,123 @@ def train(
                 jaxmon.mark_steady()
                 marked_steady = True
             skipped = False
-            try:
-                if verbose:
-                    logger.info(f"  step {j}: dispatching compiled SPMD step")
-                # dispatch: host-side time to enqueue the compiled step
-                # (includes any XLA compile on first touch); with
-                # device_sync the separate device phase then holds the
-                # device execution time this step actually took
-                with phases("dispatch"):
-                    new_state, metrics = task.step_fn(task.state, batch)
-                    task.state = new_state
-                if obs.device_sync:
-                    with phases("device"):
-                        jax.block_until_ready(metrics)
-            except Exception as e:  # OOM-skip fault tolerance
-                if _is_oom(e):
-                    if jax.process_count() > 1:
-                        # Single-host-only semantics, like the reference (skip
-                        # exists in task mode src/ddp_tasks.jl:230-238, NOT in
-                        # process mode src/sync.jl): a one-sided skip would
-                        # desynchronize step counts across hosts and strand
-                        # the others in a collective this host never enters.
-                        raise RuntimeError(
-                            "device OOM on a multi-host run: batch skipping "
-                            "cannot be coordinated one-sidedly — reduce the "
-                            "per-host batch size"
-                        ) from e
-                    leaves = jax.tree.leaves(task.state.params)
-                    if leaves and getattr(leaves[0], "is_deleted", lambda: False)():
-                        raise RuntimeError(
-                            "device OOM with donate=True: the training state was "
-                            "donated to the failed step and cannot be recovered — "
-                            "re-run prepare_training(donate=False) for OOM-skip"
-                        ) from e
-                    task.num_missed += spc
-                    task.skipped_items.append(j)
-                    oom_total.inc(spc)
-                    # the skipped batch's GLOBAL indices go on record:
-                    # the data cursor advances past it (j increments
-                    # below as for any item), so a resume after this
-                    # skip replays the exact same remaining stream —
-                    # and the log says which samples training never saw
-                    logger.log(
-                        {"oom_skipped_item": j,
-                         "oom_skipped_step_first": j * spc}, j)
-                    logger.info(f"cycle {j}: device OOM — skipping batch ({task.num_missed} missed)")
-                    skipped = True
-                else:
-                    raise
+            verdict = None
+            if guard_obj is not None and guard_obj.is_quarantined(j):
+                # pre-step quarantine skip: the batch was drawn (the
+                # data cursor must advance exactly as it did when the
+                # quarantine was decided) but is never stepped — the
+                # deterministic replay of a guard decision, and the
+                # clean-run oracle's way to skip the same batch
+                guard_obj.note_replayed_skip(j)
+                logger.info(f"cycle {j}: guard — quarantined batch skipped")
+                skipped = True
+            else:
+                # the try covers ONLY dispatch + sentinel read: recovery
+                # actions (rollback restore, halt) run after it, so a
+                # failure inside them can never be mistaken for a
+                # skippable per-batch OOM
+                try:
+                    if verbose:
+                        logger.info(f"  step {j}: dispatching compiled SPMD step")
+                    # dispatch: host-side time to enqueue the compiled step
+                    # (includes any XLA compile on first touch); with
+                    # device_sync the separate device phase then holds the
+                    # device execution time this step actually took
+                    with phases("dispatch"):
+                        new_state, metrics = task.step_fn(task.state, batch)
+                        if guard_obj is None:
+                            task.state = new_state
+                    if obs.device_sync:
+                        with phases("device"):
+                            jax.block_until_ready(metrics)
+                    if guard_obj is not None:
+                        # verdict BEFORE commit: an anomalous step's output
+                        # is discarded and the pre-step state lives on
+                        verdict = guard_obj.observe(
+                            j, metrics, can_rollback=last_good is not None)
+                        if verdict == "ok":
+                            task.state = new_state
+                        else:
+                            # the task mirrors the guard's record, so
+                            # callers (and the preemption manifest) see
+                            # decisions without reaching into guard_obj
+                            task.quarantined_items = (
+                                guard_obj.quarantined_items())
+                            if state_donated(task.state):
+                                raise RuntimeError(
+                                    "guard anomaly with donate=True: the "
+                                    "pre-step state was donated to the "
+                                    "anomalous step and cannot be recovered "
+                                    "— re-run prepare_training(donate=False)")
+                except Exception as e:  # OOM-skip fault tolerance
+                    if _is_oom(e):
+                        if jax.process_count() > 1:
+                            # Single-host-only semantics, like the reference (skip
+                            # exists in task mode src/ddp_tasks.jl:230-238, NOT in
+                            # process mode src/sync.jl): a one-sided skip would
+                            # desynchronize step counts across hosts and strand
+                            # the others in a collective this host never enters.
+                            raise RuntimeError(
+                                "device OOM on a multi-host run: batch skipping "
+                                "cannot be coordinated one-sidedly — reduce the "
+                                "per-host batch size"
+                            ) from e
+                        if state_donated(task.state):
+                            raise RuntimeError(
+                                "device OOM with donate=True: the training state was "
+                                "donated to the failed step and cannot be recovered — "
+                                "re-run prepare_training(donate=False) for OOM-skip"
+                            ) from e
+                        task.num_missed += spc
+                        task.skipped_items.append(j)
+                        oom_total.inc(spc)
+                        # the skipped batch's GLOBAL indices go on record:
+                        # the data cursor advances past it (j increments
+                        # below as for any item), so a resume after this
+                        # skip replays the exact same remaining stream —
+                        # and the log says which samples training never saw
+                        logger.log(
+                            {"oom_skipped_item": j,
+                             "oom_skipped_step_first": j * spc}, j)
+                        logger.info(f"cycle {j}: device OOM — skipping batch ({task.num_missed} missed)")
+                        skipped = True
+                    else:
+                        raise
+            # guard verdict execution — OUTSIDE the OOM-skip try: a
+            # failure while restoring a checkpoint must surface, never
+            # read as "skip this batch and continue on a half-restored
+            # state"
+            if verdict == "skip":
+                skipped = True
+                _write_guard_manifest()
+            elif verdict == "rollback":
+                from .checkpoint import load_checkpoint, wait_for_pending
+
+                logger.info(
+                    f"guard: rolling back to checkpoint step "
+                    f"{last_good['step']} (item {last_good['item']}); "
+                    f"quarantined {guard_obj.quarantined_items()}")
+                with wd_pause(), phases("checkpoint"):
+                    wait_for_pending()
+                    task.state = load_checkpoint(
+                        checkpoint_dir, task.state,
+                        step=last_good["step"], mesh=task.mesh)
+                _write_guard_manifest()
+                # rewind the data cursor and replay — the quarantined
+                # span skips on the way through
+                it.close()
+                task.loader.start = last_good["item"]
+                it = iter(task.loader)
+                j = last_good["item"]
+                continue
+            elif verdict == "halt":
+                _write_guard_manifest()
+                raise guard_obj.halt(
+                    "anomalies persist across "
+                    f"{guard_obj._rollbacks} rollback(s)"
+                    if last_good is not None else
+                    "rollback needed but no checkpoint_dir to "
+                    "roll back to")
             if not skipped:
                 if eval_every and j % eval_every == 0:
                     with wd_pause(), phases("eval"):
@@ -1340,9 +1534,20 @@ def train(
                     from .checkpoint import save_checkpoint
 
                     # async write: the device→host snapshot happens now, the disk
-                    # write overlaps subsequent steps (drained before exit below)
+                    # write overlaps subsequent steps (drained before exit below).
+                    # Guarded runs save BLOCKING instead: last_good must only
+                    # ever name a checkpoint that is durably on disk — a
+                    # rollback (or a SIGKILL resume) onto a still-streaming
+                    # save would read garbage the atomicity protocol hides
+                    # but the cursor math would still trust
                     with wd_pause(), phases("checkpoint"):
-                        save_checkpoint(task.state, checkpoint_dir, int(task.state.step), block=False)
+                        save_checkpoint(task.state, checkpoint_dir,
+                                        int(task.state.step),
+                                        block=guard_obj is not None)
+                    if guard_obj is not None:
+                        last_good = {"step": int(task.state.step),
+                                     "item": j + 1}
+                        _write_guard_manifest()
                 steps_total.inc(spc)
                 done_steps += spc
                 step_gauge.set(done_steps)

@@ -111,6 +111,10 @@ def test_main_emits_error_json_and_rc0_on_failure(bench_mod, monkeypatch, capsys
     # repo lint cleanliness is bin/lint.py --check's gate, and WIP code
     # with a finding must not fail an unrelated bench test)
     assert {"findings", "new", "by_rule"} <= set(out["lint"])
+    # the robustness stamp rides the error JSON too: a dead round
+    # records the fault/watchdog/guard counters it saw (or that it saw
+    # none — the stamp is never absent)
+    assert isinstance(out["guard"], dict)
 
     class FakeDone:
         returncode = 1

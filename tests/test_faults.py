@@ -220,6 +220,54 @@ def test_sleep_and_hang_actions_stall_then_return():
     assert faults.HANG_DELAY_SECONDS >= 600
 
 
+def test_value_actions_corrupt_fire_value_only():
+    """nan/inf actions corrupt the OBSERVED value at fire_value sites
+    (the guard's sentinel taps) and never trigger plain fire() — a
+    value corruption without a value is meaningless."""
+    import math
+
+    faults.install_plan(faults.FaultPlan.from_spec({
+        "fail": [{"site": "train.loss", "at": 2, "action": "nan"},
+                 {"site": "train.grad", "action": "inf", "times": 2}],
+    }))
+    # plain fire() at a value site: no-op (would raise if matched)
+    faults.fire("train.loss", index=2)
+    # wrong index passes through untouched
+    assert faults.fire_value("train.loss", 1.5, index=1) == 1.5
+    assert math.isnan(faults.fire_value("train.loss", 1.5, index=2))
+    # times budget then exhausts
+    assert faults.fire_value("train.loss", 1.5, index=2) == 1.5
+    for _ in range(2):
+        assert math.isinf(faults.fire_value("train.grad", 0.7))
+    assert faults.fire_value("train.grad", 0.7) == 0.7
+    reg = faults._metrics()
+    assert reg["injected"].value("train.loss") >= 1
+    assert reg["injected"].value("train.grad") >= 2
+
+
+def test_fire_value_noop_without_plan():
+    assert faults.fire_value("train.loss", 3.25, index=0) == 3.25
+
+
+def test_fire_value_delivers_side_effect_actions_too():
+    """A raise planted on a sentinel site still raises through
+    fire_value — the detection machinery itself can be failed."""
+    faults.install_plan(
+        faults.FaultPlan().fail("train.loss", message="sentinel chaos"))
+    with pytest.raises(faults.FaultInjected, match="sentinel chaos"):
+        faults.fire_value("train.loss", 1.0)
+
+
+def test_value_action_from_spec_roundtrip_validation():
+    with pytest.raises(ValueError, match="unknown fault action"):
+        faults.FaultPlan().fail("x", action="nanify")
+    plan = faults.FaultPlan.from_spec(
+        {"fail": [{"site": "train.grad", "at": 5, "action": "inf"}]})
+    faults.install_plan(plan)
+    assert faults.fire_value("train.grad", 1.0, index=4) == 1.0
+    assert faults.fire_value("train.grad", 1.0, index=5) == float("inf")
+
+
 def test_serve_tick_site_fires_in_scheduler_step():
     """The scheduler's per-tick injection point: tick k raises inside
     step() — and the LMServer engine loop is built to survive exactly

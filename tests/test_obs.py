@@ -241,6 +241,54 @@ def test_watchdog_pause_exempts_known_long_work():
     assert w.threshold() == pytest.approx(med_before, rel=0.9)
 
 
+def test_watchdog_escalates_persistent_stall():
+    """A stall that persists escalate_after further threshold windows
+    fires ONE escalation (counter + abort callback) — today's
+    warn-once would otherwise sit silent on a permanently wedged loop."""
+    r = Registry()
+    escalated = []
+    w = StepWatchdog(factor=2.0, min_interval=0.01, warmup=2, registry=r,
+                     escalate_after=2,
+                     on_escalate=lambda e, th: escalated.append((e, th)))
+    for i in range(6):
+        w.beat()
+    # drive poll() with synthetic clocks: the threshold is
+    # min_interval-floored, escalation sits at (1 + 2) x threshold
+    thr = w.threshold()
+    base = time.monotonic()
+    assert w.poll(now=base + 2 * thr) is True  # the stall fires first
+    assert r.value("fdtpu_watchdog_stalls_total") >= 1
+    assert r.value("fdtpu_watchdog_escalations_total") == 0
+    # inside the escalation window: nothing yet
+    w.poll(now=base + 2.5 * thr)
+    assert escalated == []
+    # past (1 + escalate_after) x threshold: exactly one escalation
+    w.poll(now=base + 3.5 * thr)
+    w.poll(now=base + 5.0 * thr)
+    assert len(escalated) == 1
+    assert r.value("fdtpu_watchdog_escalations_total") == 1
+    # a beat re-arms the whole episode machinery
+    w.beat()
+    assert r.value("fdtpu_watchdog_stalled") == 0
+    w.poll(now=base + 100.0)
+    w.poll(now=base + 200.0)
+    assert r.value("fdtpu_watchdog_escalations_total") == 2
+
+
+def test_watchdog_escalation_disabled_by_default():
+    r = Registry()
+    w = StepWatchdog(factor=2.0, min_interval=0.01, warmup=2, registry=r)
+    for _ in range(6):
+        w.beat()
+    thr = w.threshold()
+    w.poll(now=time.monotonic() + thr * 2)
+    w.poll(now=time.monotonic() + thr * 1000)
+    assert r.value("fdtpu_watchdog_stalls_total") == 1
+    assert r.value("fdtpu_watchdog_escalations_total") == 0
+    with pytest.raises(ValueError, match="escalate_after"):
+        StepWatchdog(escalate_after=-1, registry=r)
+
+
 def test_watchdog_pause_does_not_collapse_median():
     """The beat that ends a pause-containing iteration measures only
     the post-pause remainder; recording it would drive the rolling

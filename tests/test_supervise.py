@@ -1,0 +1,230 @@
+"""Trainer supervisor (bin/supervise.py) — classification + restarts.
+
+Fast tier drives the Supervisor against FAKE child processes (tiny
+python scripts + a test-owned metrics endpoint), so every exit class —
+done / preempted / crashed / stalled / escalated / halted — and the
+argv-rewrite rules are proven in seconds with no jax in the child.
+The slow tier runs the real thing: ``bin/supervise.py --smoke``, a
+driver run with an injected NaN (guard-quarantined) and a hang
+(supervisor-SIGKILLed + resumed) that must still COMPLETE.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1] / "bin"))
+import supervise  # noqa: E402
+
+from fluxdistributed_tpu.faults import HALTED_RC, PREEMPTED_RC  # noqa: E402
+from fluxdistributed_tpu.obs import MetricsServer  # noqa: E402
+from fluxdistributed_tpu.obs.metrics import Registry  # noqa: E402
+
+REPO = str(pathlib.Path(__file__).resolve().parents[1])
+
+
+def write_child(tmp_path, body: str) -> str:
+    """A fake child script; ``marker`` (argv[1]) distinguishes the
+    first episode from restarts."""
+    path = tmp_path / "child.py"
+    path.write_text(textwrap.dedent(body))
+    return str(path)
+
+
+def run_supervisor(cmd, tmp_path, **kw):
+    led = tmp_path / "ledger.json"
+    kw.setdefault("verbose", False)
+    kw.setdefault("poll_interval", 0.05)
+    kw.setdefault("backoff", 0.01)
+    sup = supervise.Supervisor(cmd, ledger=str(led), **kw)
+    rc = sup.run()
+    return rc, json.loads(led.read_text())
+
+
+def classes(ledger):
+    return [e["class"] for e in ledger["episodes"]]
+
+
+# ---------------------------------------------------------------------------
+# exit classification + argv rewrite (fake children)
+# ---------------------------------------------------------------------------
+
+
+def test_preempted_then_done_appends_resume_strips_fault_plan(tmp_path):
+    child = write_child(tmp_path, """
+        import os, sys
+        marker = sys.argv[1]
+        if not os.path.exists(marker):
+            open(marker, "w").write("x")
+            sys.exit(75)
+        sys.exit(0)
+    """)
+    cmd = [sys.executable, child, str(tmp_path / "m"),
+           "--checkpoint-dir", "ck", "--fault-plan", "{}"]
+    rc, led = run_supervisor(cmd, tmp_path)
+    assert rc == 0 and led["completed"]
+    assert classes(led) == ["preempted", "done"]
+    assert led["resumes"] == 1 and led["restarts"] == 0
+    ep2 = led["episodes"][1]["argv"]
+    assert "--resume" in ep2, "restart must resume from the checkpoint"
+    assert "--fault-plan" not in ep2, (
+        "an injected fault is one occurrence of weather, not a curse "
+        "on every successor")
+
+
+def test_keep_fault_plan_flag(tmp_path):
+    child = write_child(tmp_path, """
+        import os, sys
+        marker = sys.argv[1]
+        if not os.path.exists(marker):
+            open(marker, "w").write("x")
+            sys.exit(75)
+        sys.exit(0)
+    """)
+    cmd = [sys.executable, child, str(tmp_path / "m"), "--fault-plan", "{}"]
+    rc, led = run_supervisor(cmd, tmp_path, keep_fault_plan=True)
+    assert rc == 0
+    assert "--fault-plan" in led["episodes"][1]["argv"]
+    # no --checkpoint-dir in argv -> no --resume appended (nothing to
+    # resume from)
+    assert "--resume" not in led["episodes"][1]["argv"]
+
+
+def test_crash_restarts_bounded_with_backoff(tmp_path):
+    child = write_child(tmp_path, "import sys; sys.exit(3)\n")
+    rc, led = run_supervisor([sys.executable, child], tmp_path,
+                             max_restarts=2)
+    assert rc == 3
+    assert classes(led) == ["crashed"] * 3  # first run + 2 restarts
+    assert led["result"] == "restart_budget_exhausted"
+    assert not led["completed"]
+    assert all(e["action"] != "stop" for e in led["episodes"][:-1])
+
+
+def test_guard_halt_rc_stops_immediately(tmp_path):
+    child = write_child(tmp_path, f"import sys; sys.exit({HALTED_RC})\n")
+    rc, led = run_supervisor([sys.executable, child], tmp_path)
+    assert rc == HALTED_RC
+    assert classes(led) == ["halted"]
+    assert led["result"] == "halted" and not led["completed"]
+
+
+def test_resume_budget_bounded(tmp_path):
+    child = write_child(tmp_path, f"import sys; sys.exit({PREEMPTED_RC})\n")
+    rc, led = run_supervisor([sys.executable, child], tmp_path,
+                             max_resumes=2)
+    assert rc == PREEMPTED_RC
+    assert classes(led) == ["preempted"] * 3
+    assert led["result"] == "resume_budget_exhausted"
+
+
+# ---------------------------------------------------------------------------
+# heartbeat watching (fake child + test-owned metrics endpoint)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def metrics_endpoint():
+    reg = Registry()
+    srv = MetricsServer(registry=reg)
+    srv.start(host="127.0.0.1", port=0)
+    yield reg, srv.port
+    srv.stop()
+
+
+STALL_CHILD = """
+    import os, sys, time
+    marker, port = sys.argv[1], sys.argv[2]
+    if not os.path.exists(marker):
+        open(marker, "w").write("x")
+        print(f"metrics: http://0.0.0.0:{port}/metrics (+ /healthz)",
+              flush=True)
+        time.sleep(120)  # wedged: steps counter never moves again
+    sys.exit(0)
+"""
+
+
+def test_stalled_child_is_sigkilled_and_restarted(tmp_path,
+                                                  metrics_endpoint):
+    reg, port = metrics_endpoint
+    reg.counter("fdtpu_train_steps_total", "x").inc(3)
+    child = write_child(tmp_path, STALL_CHILD)
+    cmd = [sys.executable, child, str(tmp_path / "m"), str(port)]
+    rc, led = run_supervisor(cmd, tmp_path, stall_timeout=1.0,
+                             startup_grace=10.0)
+    assert rc == 0 and led["completed"]
+    assert classes(led) == ["stalled", "done"]
+    # the episode recorded what it saw before the kill
+    assert led["episodes"][0]["steps"] == 3
+    assert "fdtpu_train_steps_total" in led["episodes"][0]["counters"]
+
+
+def test_watchdog_escalation_triggers_kill(tmp_path, metrics_endpoint):
+    """The wedged-collective signal: steps may look merely slow, but an
+    escalation tick means the in-process watchdog declared the loop
+    dead — the supervisor kills on it without waiting out the stall
+    timeout."""
+    reg, port = metrics_endpoint
+    steps = reg.counter("fdtpu_train_steps_total", "x")
+    steps.inc(1)
+    esc = reg.counter("fdtpu_watchdog_escalations_total", "x")
+    child = write_child(tmp_path, STALL_CHILD)
+    cmd = [sys.executable, child, str(tmp_path / "m"), str(port)]
+
+    import threading
+    import time as _time
+
+    def tick():
+        _time.sleep(0.7)
+        esc.inc()
+
+    threading.Thread(target=tick, daemon=True).start()
+    rc, led = run_supervisor(cmd, tmp_path, stall_timeout=30.0,
+                             startup_grace=10.0)
+    assert rc == 0
+    assert classes(led) == ["escalated", "done"]
+    assert led["episodes"][0]["wall_seconds"] < 10
+
+
+def test_metrics_parsing_helpers():
+    text = ("# HELP x y\n# TYPE x counter\n"
+            "fdtpu_train_steps_total 7\n"
+            'fdtpu_fault_injected_total{site="a"} 2\n'
+            'fdtpu_fault_injected_total{site="b"} 3\n'
+            "not a number nan_is_fine nope\n")
+    m = supervise.parse_metrics(text)
+    assert supervise.series_value(m, "fdtpu_train_steps_total") == 7
+    assert supervise.series_value(m, "fdtpu_fault_injected_total") == 5
+    assert supervise.series_value(m, "missing") == 0
+
+
+# ---------------------------------------------------------------------------
+# the real thing (slow tier; CI runs the same gate as a fast-job step)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_supervise_smoke_e2e(tmp_path):
+    """NaN at step 2 -> guard quarantine; hang at step 5 -> supervisor
+    SIGKILL + --resume; the run COMPLETES with zero human input."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    ledger = tmp_path / "ledger.json"
+    p = subprocess.run(
+        [sys.executable, os.path.join("bin", "supervise.py"),
+         "--smoke", "--quiet", "--ledger", str(ledger)],
+        capture_output=True, text=True, timeout=540, env=env, cwd=REPO)
+    assert p.returncode == 0, (p.stdout[-2000:], p.stderr[-2000:])
+    led = json.loads(ledger.read_text())
+    assert led["completed"]
+    cls = classes(led)
+    assert cls[-1] == "done" and any(
+        c in ("stalled", "escalated") for c in cls), cls
