@@ -234,6 +234,34 @@ def lint_stamp():
         return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def pp_plan_stamp():
+    """The pipeline-planner paired-row stamp for the bench JSON: the
+    profile-guided planner (parallel/pp_plan.py) run on THIS box's
+    static costs for a production-shaped LM (lm_small geometry, 32k
+    vocab) — uniform vs planned stage boundaries with the modeled
+    bubble of each.  Staging only, nothing compiles, and like the
+    lint/guard stamps it never raises: every round's artifact records
+    whether (and by how much) planner placement beats uniform splits
+    here, next to the measured rows hw_session's pp_bubble stage
+    produces."""
+    try:
+        from fluxdistributed_tpu.models.transformer_lm import lm_small
+        from fluxdistributed_tpu.parallel.pp_plan import plan_from_model
+
+        S, M = 4, 16
+        model = lm_small(dropout=0.0)
+        plan = plan_from_model(model, S, M, batch_size=8, seqlen=1024)
+        return {
+            "S": S, "M": M, "depth": int(model.depth),
+            "boundaries_planned": list(plan.boundaries),
+            "counts_planned": list(plan.counts),
+            "modeled_bubble_planned": round(plan.modeled_bubble, 4),
+            "modeled_bubble_uniform": round(plan.uniform_bubble, 4),
+        }
+    except Exception as e:  # noqa: BLE001 — stamp is best-effort
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def default_cache_dir():
     """Resolve the persistent-compile-cache root for bench runs:
     ``FDTPU_COMPILE_CACHE_DIR`` when set (empty string disables), else
@@ -602,6 +630,9 @@ def _measure():
         # robustness forensics: fault/watchdog/guard counters this
         # measurement accumulated (retries survived, stalls seen)
         "guard": guard_stamp(),
+        # planner paired row: uniform vs planned modeled bubble for a
+        # production-shaped LM on this box's static costs
+        "pp_plan": pp_plan_stamp(),
     }
 
 

@@ -1,20 +1,33 @@
 #!/usr/bin/env python
 """Measured pipeline-schedule scaling vs the (S-1)/(M+S-1) formula,
-GPipe (AD-derived backward) vs hand-scheduled 1F1B — with modeled-vs-
-measured bubble accounting from a cost-profile artifact.
+GPipe (AD-derived backward) vs hand-scheduled 1F1B vs zero-bubble —
+with modeled-vs-measured bubble accounting from a cost-profile
+artifact, and planner-paired rows.
 
 The GPipe schedule (parallel/pp.py:26-28) predicts utilization
 M/(M+S-1) for M microbatches over S stages.  This script times the
 pipelined LM forward+backward at M in {S, 2S, 4S, 8S} for either
-schedule (``--schedule gpipe|1f1b``) and reports per-microbatch cost
-scaling (VERDICT r3 weak #6).
+schedule (``--schedule gpipe|1f1b|zb``) and reports per-microbatch
+cost scaling (VERDICT r3 weak #6).
 
-Bubble accounting (ROADMAP item 4): the run stages out the model for
-per-layer static costs (``obs.profile.lm_layer_costs``), fits the
+Pairings (ROADMAP item 4's planner loop, both modeled AND measured):
+
+* ``--plan auto|PATH`` adds a PLANNED row sweep next to the uniform
+  one — same schedule, stage boundaries from the profile-guided
+  planner (``parallel/pp_plan.py``; 'auto' plans from fresh static
+  costs, PATH loads a profile artifact or saved plan) — so the
+  planned-vs-uniform bubble delta is measured, not just modeled;
+* ``--with-zb`` (with ``--schedule 1f1b``) adds a zero-bubble row
+  sweep — the 1f1b-vs-zb pairing on identical data and params.
+
+Bubble accounting: the run stages out the model for per-layer static
+costs (``obs.profile.lm_layer_costs``), fits each configuration's
 measured rows to separate steady per-microbatch cost from fixed
 fill/drain overhead, and reports the MODELED bubble fraction (schedule
-formula over the static per-stage costs) next to the MEASURED one per
-row (``obs.profile.bubble_report``).  ``--profile-out`` persists
+formula over the static per-stage costs at that configuration's
+boundaries) next to the MEASURED one per row
+(``obs.profile.bubble_report`` — rows are tagged ``schedule``/
+``boundaries`` and fitted per group).  ``--profile-out`` persists
 everything as a versioned, topology-fingerprinted Profile artifact;
 ``--profile`` replays the report from a saved artifact without timing
 anything (rejecting cross-topology artifacts unless
@@ -30,10 +43,13 @@ What each substrate can show:
   min(S,M)-slot input ring keeps per-microbatch cost ~flat — that
   contrast is the point of the comparison here.  The measured-bubble
   column follows suit: on real chips it is idle time, on the CPU mesh
-  it is the schedule's fixed-overhead fraction.
+  it is the schedule's fixed-overhead fraction.  The zb schedule in
+  particular trades MORE ticks (3 cheap vs 2 expensive per microbatch)
+  for near-zero idle — a win where devices idle, pure overhead on the
+  never-idle CPU mesh (docs/parallelism.md spells out the caveat).
 
     python benchmarks/pp_bubble.py --platform cpu --dim 128 --depth 8 \
-        --profile-out pp_profile.json
+        --schedule 1f1b --plan auto --with-zb --profile-out pp_profile.json
     python benchmarks/pp_bubble.py --platform cpu --profile pp_profile.json
 """
 
@@ -105,11 +121,21 @@ def main():
                     help="sequences per microbatch (fixed; M scales total batch)")
     ap.add_argument("--vocab", type=int, default=512)
     ap.add_argument("--seconds", type=float, default=2.0)
-    ap.add_argument("--schedule", choices=("gpipe", "1f1b"), default="gpipe")
+    ap.add_argument("--schedule", choices=("gpipe", "1f1b", "zb"),
+                    default="gpipe")
     ap.add_argument("--remat", action="store_true",
                     help="gpipe only: lm_pp(remat=True) — per-tick input "
                          "checkpointing, the AD-side answer to the residual "
                          "blowup (compare against the 1f1b rows)")
+    ap.add_argument("--plan", default=None, metavar="auto|PATH",
+                    help="pair every row sweep with a PLANNED one: stage "
+                         "boundaries from the profile-guided planner "
+                         "('auto' = fresh static costs; PATH = profile "
+                         "artifact or saved plan JSON) next to the "
+                         "uniform split — measured planned-vs-uniform")
+    ap.add_argument("--with-zb", action="store_true",
+                    help="with --schedule 1f1b: add a zero-bubble row "
+                         "sweep — measured 1f1b-vs-zb on identical data")
     ap.add_argument("--profile-out", default=None, metavar="PATH",
                     help="persist this run (static per-layer costs + "
                          "measured rows + topology fingerprint) as an "
@@ -120,13 +146,17 @@ def main():
                          "measured bubble report from this saved "
                          "artifact (topology-checked)")
     ap.add_argument("--allow-mismatch", action="store_true",
-                    help="with --profile: analyze an artifact recorded "
-                         "on a DIFFERENT topology (numbers then "
-                         "describe that topology, not this box)")
+                    help="with --profile or --plan PATH: analyze an "
+                         "artifact recorded on a DIFFERENT topology "
+                         "(numbers then describe that topology, not "
+                         "this box)")
     args = ap.parse_args()
     if args.remat and args.schedule != "gpipe":
         ap.error("--remat applies to --schedule gpipe only (1f1b always "
                  "recomputes from its input ring)")
+    if args.with_zb and args.schedule != "1f1b":
+        ap.error("--with-zb pairs the zero-bubble schedule against "
+                 "--schedule 1f1b rows")
     if args.profile:
         report_from_artifact(args)
         return
@@ -145,6 +175,11 @@ def main():
     )
 
     S = jax.device_count()
+    if S < 2:
+        raise SystemExit(
+            f"pipeline benchmarking needs >= 2 devices, got {S} — on a "
+            "single-chip target there is no pipe axis to schedule over "
+            "(CPU: pass --platform cpu --devices N)")
     mesh = mesh_lib.make_mesh({"pipe": S})
     model = TransformerLM(
         vocab=args.vocab, dim=args.dim, depth=args.depth,
@@ -155,66 +190,139 @@ def main():
     toks1 = rng.integers(0, args.vocab, (args.mb_size, args.seqlen)).astype(np.int32)
     params = model.init(jax.random.PRNGKey(0), toks1, train=False)["params"]
 
+    # ---- planner pairing: resolve the planned boundaries once (they
+    # depend on costs, not M); rows then sweep uniform AND planned.
+    # The planning M (2S) is the sweep's second row; boundaries are
+    # M-independent so any in-range choice models the same placement.
+    plan = None
+    if args.plan:
+        from fluxdistributed_tpu.obs.profile import ProfileMismatch
+        from fluxdistributed_tpu.parallel.pp_plan import (
+            PlanError, resolve_plan,
+        )
+
+        try:
+            plan = resolve_plan(
+                args.plan, S, 2 * S,
+                schedule="zb" if args.schedule == "zb" else "1f1b",
+                model=model,
+                # full batch at the planning M: the planner divides by
+                # M itself for the activation-ring estimate
+                batch_size=args.mb_size * 2 * S,
+                seqlen=args.seqlen,
+                verify=not args.allow_mismatch)
+        except (PlanError, ProfileMismatch, ValueError, OSError) as e:
+            raise SystemExit(
+                f"--plan {args.plan}: {e}\n(pass --allow-mismatch to "
+                "analyze a foreign artifact anyway)")
+        print(json.dumps({"plan": plan.describe(),
+                          "boundaries": list(plan.boundaries)}), flush=True)
+        if plan.is_uniform:
+            # a real result, not a sweep: the planner confirms uniform
+            # placement is optimal here — don't burn wall time (on a
+            # chip: grant time) measuring bit-identical configurations
+            print(json.dumps({
+                "note": "plan is UNIFORM for this model/topology "
+                        "(planned rows skipped — they would duplicate "
+                        "the uniform sweep)",
+                "modeled_bubble": plan.modeled_bubble}), flush=True)
+
+    planned_bounds = (plan.boundaries
+                      if plan is not None and not plan.is_uniform else None)
+    configs = [(args.schedule, None)]
+    if planned_bounds is not None:
+        configs.append((args.schedule, planned_bounds))
+    if args.with_zb:
+        configs.append(("zb", None))
+        if planned_bounds is not None:
+            configs.append(("zb", planned_bounds))
+
     rows = []
-    base_per_mb = None
-    for mult in (1, 2, 4, 8):
-        M = S * mult
-        batch = args.mb_size * M
-        toks = rng.integers(0, args.vocab, (batch, args.seqlen)).astype(np.int32)
-        if args.schedule == "1f1b":
-            from fluxdistributed_tpu.parallel.pp_1f1b import pipeline_grads_1f1b
+    for sched, bounds in configs:
+        # every configuration times IDENTICAL token batches (the
+        # pairing promise): re-seed per config so the M-sweep draws
+        # the same sequence each time
+        rng = np.random.default_rng(1)
+        base_per_mb = None
+        # zb runs 3 cheap ticks per microbatch where 1f1b runs 2
+        # expensive ones; its fill/drain term is one third
+        drain = (S - 1) / 3.0 if sched == "zb" else float(S - 1)
+        for mult in (1, 2, 4, 8):
+            M = S * mult
+            batch = args.mb_size * M
+            toks = rng.integers(
+                0, args.vocab, (batch, args.seqlen)).astype(np.int32)
+            if sched == "gpipe":
+                split_params, loss_fn, _ = lm_pp(
+                    model, mesh, num_microbatches=M, remat=args.remat,
+                    boundaries=bounds)
+                pp = split_params(params)
 
-            w = lm_pp_1f1b(model, mesh)
-            pp = w.split_params(params)
-            run = pipeline_grads_1f1b(
-                *w.fns, mesh, num_microbatches=M, interleave=w.interleave)
+                @jax.jit
+                def fwdbwd(p, t):
+                    # loss on the pipelined forward; grads run the
+                    # reverse schedule
+                    def loss(pp_):
+                        l, _aux = loss_fn(pp_, {}, {"tokens": t}, False)
+                        return l
 
-            @jax.jit
-            def fwdbwd(p, t):
-                # the 1F1B program IS fwd+bwd: loss and both grad trees
-                return run(p["stages"], p["outer"], t, t)
+                    return jax.value_and_grad(loss)(p)
 
-        else:
-            split_params, loss_fn, _ = lm_pp(
-                model, mesh, num_microbatches=M, remat=args.remat)
-            pp = split_params(params)
+            else:
+                from fluxdistributed_tpu.parallel.pp_1f1b import (
+                    pipeline_grads_1f1b,
+                )
 
-            @jax.jit
-            def fwdbwd(p, t):
-                # loss on the pipelined forward; grads run the reverse schedule
-                def loss(pp_):
-                    l, _aux = loss_fn(pp_, {}, {"tokens": t}, False)
-                    return l
+                w = lm_pp_1f1b(model, mesh, boundaries=bounds)
+                pp = w.split_params(params)
+                run = pipeline_grads_1f1b(
+                    *w.fns, mesh, num_microbatches=M,
+                    interleave=w.interleave, schedule=sched)
 
-                return jax.value_and_grad(loss)(p)
+                @jax.jit
+                def fwdbwd(p, t):
+                    # the 1F1B/zb program IS fwd+bwd: loss + both grad
+                    # trees
+                    return run(p["stages"], p["outer"], t, t)
 
-        l, *g = fwdbwd(pp, toks)
-        jax.block_until_ready(l)
-        t0 = time.perf_counter()
-        iters = 0
-        while time.perf_counter() - t0 < args.seconds:
             l, *g = fwdbwd(pp, toks)
-            iters += 1
-        jax.block_until_ready(l)
-        dt = (time.perf_counter() - t0) / iters
-        per_mb = dt / M
-        if base_per_mb is None:
-            base_per_mb = per_mb  # M=S row anchors the comparison
-        util_pred = M / (M + S - 1)
-        # measured utilization relative to the M=S anchor's prediction
-        util_meas = (base_per_mb / per_mb) * (S / (2 * S - 1))
-        rows.append({
-            "M": M, "S": S, "batch": batch,
-            "step_ms": round(dt * 1e3, 2),
-            "ms_per_microbatch": round(per_mb * 1e3, 3),
-            "util_formula": round(util_pred, 4),
-            "util_measured": round(util_meas, 4),
-        })
-        print(json.dumps(rows[-1]), flush=True)
+            jax.block_until_ready(l)
+            t0 = time.perf_counter()
+            iters = 0
+            while time.perf_counter() - t0 < args.seconds:
+                l, *g = fwdbwd(pp, toks)
+                iters += 1
+            jax.block_until_ready(l)
+            dt = (time.perf_counter() - t0) / iters
+            per_mb = dt / M
+            if base_per_mb is None:
+                base_per_mb = per_mb  # M=S row anchors the comparison
+            util_pred = M / (M + drain)
+            # measured utilization relative to the M=S anchor's
+            # prediction for THIS schedule's drain term
+            util_meas = (base_per_mb / per_mb) * (S / (S + drain))
+            row = {
+                "M": M, "S": S, "batch": batch,
+                "schedule": sched,
+                "step_ms": round(dt * 1e3, 2),
+                "ms_per_microbatch": round(per_mb * 1e3, 3),
+                "util_formula": round(util_pred, 4),
+                "util_measured": round(util_meas, 4),
+            }
+            if bounds is not None:
+                row["boundaries"] = list(bounds)
+            rows.append(row)
+            print(json.dumps(row), flush=True)
 
+    pairings = []
+    if planned_bounds is not None:
+        pairings.append("planned-vs-uniform")
+    if args.with_zb:
+        pairings.append("1f1b-vs-zb")
     print(json.dumps({
         "metric": f"{args.schedule}{'-remat' if args.remat else ''} "
-                  "pipeline: measured vs (S-1)/(M+S-1)",
+                  "pipeline: measured vs M/(M+drain)"
+                  + (f" [{', '.join(pairings)}]" if pairings else ""),
         "platform": jax.devices()[0].platform,
         "rows": rows,
     }))
@@ -237,7 +345,10 @@ def main():
         measured={"pp_rows": rows},
         meta={"schedule": args.schedule, "remat": bool(args.remat),
               "mb_size": args.mb_size, "seqlen": args.seqlen,
-              "vocab": args.vocab, "producer": "benchmarks/pp_bubble.py"},
+              "vocab": args.vocab, "producer": "benchmarks/pp_bubble.py",
+              "with_zb": bool(args.with_zb),
+              "plan_boundaries": (list(plan.boundaries)
+                                  if plan is not None else None)},
     )
     if args.profile_out:
         prof.save(args.profile_out)
@@ -247,7 +358,8 @@ def main():
     print(json.dumps({
         "metric": f"{args.schedule} pp bubble fraction, modeled "
                   "(static per-stage costs through the schedule model) "
-                  "vs measured (fixed-cost share of wall time)",
+                  "vs measured (fixed-cost share of wall time, fitted "
+                  "per schedule/boundaries group)",
         "platform": jax.devices()[0].platform,
         "rows": breport,
     }))

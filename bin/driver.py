@@ -97,6 +97,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Megatron interleaved virtual stages for --spmd "
                         "pp_1f1b (depth/pipe chunks per device; ~V-fold "
                         "smaller fill/drain bubble)")
+    p.add_argument("--pp-schedule", default="1f1b", choices=["1f1b", "zb"],
+                   help="pipeline timetable for --spmd pp_1f1b: classic "
+                        "1F1B, or 'zb' (zero-bubble ZB-H1: each backward "
+                        "splits into input-grad + deferred weight-grad "
+                        "ticks and the weight-grad work fills the drain "
+                        "bubble; bit-identical gradients)")
+    p.add_argument("--pp-plan", default=None, metavar="PATH|auto",
+                   help="profile-guided stage placement for --spmd "
+                        "pp/pp_1f1b: 'auto' stages the model out and "
+                        "plans from fresh static costs; PATH loads a "
+                        "cost-profile artifact (--profile-out output) or "
+                        "a saved plan JSON — non-uniform stage boundaries "
+                        "minimizing the modeled max-stage cost (also "
+                        "lifts the depth %% pipe divisibility "
+                        "requirement).  Cross-topology artifacts are "
+                        "rejected via the fingerprint check")
     p.add_argument("--expert-parallel", type=int, default=None,
                    help="expert-axis size for --spmd ep (mesh becomes "
                         "{data: N/ep, expert: ep}; defaults to all devices)")
@@ -258,6 +274,31 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--local-devices", type=int, default=None,
                    help="virtual CPU devices per process (fake-cluster mode)")
     return p
+
+
+def _resolve_pp_plan(args, model, mesh):
+    """``--pp-plan``: 'auto' stages the model out for fresh static
+    costs; a path loads a cost-profile artifact (planned here) or a
+    saved plan JSON (planned elsewhere) — the shared
+    ``parallel.pp_plan.resolve_plan`` implementation, which rejects
+    cross-topology artifacts through the fingerprint check
+    (``prepare_training`` re-checks at consume time too)."""
+    from fluxdistributed_tpu import mesh as mesh_lib
+    from fluxdistributed_tpu.obs.profile import ProfileMismatch
+    from fluxdistributed_tpu.parallel.pp_plan import PlanError, resolve_plan
+
+    S = mesh.shape[mesh_lib.PIPE_AXIS]
+    n_data = mesh.shape[mesh_lib.DATA_AXIS]
+    M = args.microbatches or 2 * S
+    try:
+        return resolve_plan(
+            args.pp_plan, S, M,
+            schedule=args.pp_schedule if args.spmd == "pp_1f1b" else "1f1b",
+            model=model,
+            batch_size=max(args.batch_size // max(n_data, 1), 1),
+            seqlen=args.seqlen)
+    except (PlanError, ProfileMismatch, ValueError, OSError) as e:
+        raise SystemExit(f"--pp-plan {args.pp_plan}: {e}")
 
 
 def main(argv=None) -> int:
@@ -498,6 +539,13 @@ def main(argv=None) -> int:
         raise SystemExit("--microbatches only applies with --spmd pp or pp_1f1b")
     if args.pp_interleave and args.spmd != "pp_1f1b":
         raise SystemExit("--pp-interleave only applies with --spmd pp_1f1b")
+    if args.pp_schedule != "1f1b" and args.spmd != "pp_1f1b":
+        raise SystemExit("--pp-schedule zb only applies with --spmd pp_1f1b")
+    if args.pp_plan is not None and args.spmd not in ("pp", "pp_1f1b"):
+        raise SystemExit("--pp-plan only applies with --spmd pp or pp_1f1b")
+    if args.pp_plan is not None and args.pp_interleave:
+        raise SystemExit("--pp-plan cannot combine with --pp-interleave "
+                         "(planner boundaries are contiguous block ranges)")
     if (args.expert_parallel is not None or args.experts is not None
             or args.moe_every is not None) and args.spmd != "ep":
         raise SystemExit(
@@ -521,6 +569,12 @@ def main(argv=None) -> int:
         mesh, _ = data_x_mesh("pipe", "--pipe", args.pipe)
         lm_extra["num_microbatches"] = args.microbatches
         lm_extra["pipeline_interleave"] = args.pp_interleave
+        lm_extra["pipeline_schedule"] = args.pp_schedule
+        if args.pp_plan:
+            plan = _resolve_pp_plan(args, model, mesh)
+            lm_extra["pp_plan"] = plan
+            if multihost.is_coordinator():
+                print(plan.describe())
     elif args.spmd == "ep":
         mesh = ep_mesh
     elif args.spmd == "sp":

@@ -146,6 +146,44 @@ def _build_pp_1f1b() -> List[StepVariant]:
                      spmd="pp_1f1b", num_microbatches=2, topk=())
 
 
+def _build_pp_planned() -> List[StepVariant]:
+    """The planner-placed pipeline: depth 6 over 4 pipe devices via a
+    non-uniform PipelinePlan (counts [1, 2, 2, 1] — padded chunk scan,
+    cond-skipped idle chunks, lifted depth-divisibility requirement).  Sweeping
+    it proves the counts-aware ``chunk_stages`` program keeps the pp
+    invariants: donation consumable, axis hygiene, stable retrace
+    digests (the counts table is baked, never an argument)."""
+    from .. import mesh as mesh_lib
+    from ..parallel import pp_plan as pp_plan_mod
+
+    mesh = mesh_lib.make_mesh(
+        {mesh_lib.DATA_AXIS: 2, mesh_lib.PIPE_AXIS: 4})
+    model, ds = _lm_setup(depth=6, heads=2)
+    # flat block costs + outer weight on the end stages -> the planner
+    # thins the first/last stage: boundaries (0, 1, 3, 5, 6)
+    plan = pp_plan_mod.plan_stages(
+        [1.0] * 6, 4, 2, outer=(1.0, 1.0))
+    return _prepared("pp_planned", model, ds, mesh, pp_plan_mod,
+                     spmd="pp_1f1b", num_microbatches=2, topk=(),
+                     pp_plan=plan)
+
+
+def _build_pp_zb() -> List[StepVariant]:
+    """The zero-bubble schedule (pp_1f1b ``schedule="zb"``): B/W-split
+    backward, cot-stash ring riding the scan carry.  Swept so the W
+    tick's cond branches and the extra carry keep donation/axis/retrace
+    hygiene."""
+    from .. import mesh as mesh_lib
+    from ..parallel import pp_1f1b
+
+    mesh = mesh_lib.make_mesh(
+        {mesh_lib.DATA_AXIS: 2, mesh_lib.PIPE_AXIS: 4})
+    model, ds = _lm_setup(depth=4, heads=2)
+    return _prepared("pp_zb", model, ds, mesh, pp_1f1b,
+                     spmd="pp_1f1b", num_microbatches=2, topk=(),
+                     pipeline_schedule="zb")
+
+
 def _build_context() -> List[StepVariant]:
     from .. import mesh as mesh_lib
     from ..models.transformer_lm import lm_loss_fn
@@ -309,6 +347,8 @@ VARIANT_BUILDERS: Dict[str, Callable[[], List[StepVariant]]] = {
     "fsdp": _build_fsdp,
     "tp": _build_tp,
     "pp_1f1b": _build_pp_1f1b,
+    "pp_planned": _build_pp_planned,
+    "pp_zb": _build_pp_zb,
     "context": _build_context,
     "serve": _build_serve,
     "serve_paged": _build_serve_paged,

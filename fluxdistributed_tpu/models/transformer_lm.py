@@ -1306,15 +1306,36 @@ def generate(
     return out
 
 
+def _validate_pp_boundaries(boundaries, S: int, depth: int, what: str):
+    """Planner boundaries sanity: S+1 monotone cut points covering the
+    whole stack with >= 1 block per stage.  Returns them as a tuple."""
+    b = tuple(int(x) for x in boundaries)
+    if len(b) != S + 1:
+        raise ValueError(
+            f"{what}: boundaries needs S+1 = {S + 1} cut points for the "
+            f"{S}-stage pipe axis, got {len(b)} ({list(b)})")
+    if b[0] != 0 or b[-1] != depth:
+        raise ValueError(
+            f"{what}: boundaries must span the whole stack "
+            f"(0 .. depth={depth}), got {list(b)}")
+    if any(b[s + 1] <= b[s] for s in range(S)):
+        raise ValueError(
+            f"{what}: every stage needs >= 1 block (strictly increasing "
+            f"boundaries), got {list(b)}")
+    return b
+
+
 def _pp_validate_and_stage(model: "TransformerLM", mesh, pipe_axis: str, what: str,
-                           blocked: bool = True):
+                           blocked: bool = True, boundaries=None):
     """Shared lm_pp/lm_pp_1f1b front half: validate the model is
     pipelineable, and build the per-stage callable.  Returns
-    ``(S, V, stage_fn)`` — V logical blocks hosted per pipe device.
-    ``blocked=True`` wraps V > 1 into one ``chunk_stages`` scan per tick
-    (GPipe / plain 1F1B); ``blocked=False`` returns the single-block
-    callable for the interleaved 1F1B schedule, which applies one
-    logical block per tick itself."""
+    ``(S, V, stage_fn)`` — V logical blocks hosted per pipe device
+    (``max(counts)`` under planner ``boundaries``, whose non-uniform
+    splits ride a counts-aware ``chunk_stages``).  ``blocked=True``
+    wraps V > 1 into one ``chunk_stages`` scan per tick (GPipe / plain
+    1F1B); ``blocked=False`` returns the single-block callable for the
+    interleaved 1F1B schedule, which applies one logical block per tick
+    itself."""
     from ..parallel.pp import chunk_stages
 
     if not model.use_rope:
@@ -1330,12 +1351,25 @@ def _pp_validate_and_stage(model: "TransformerLM", mesh, pipe_axis: str, what: s
             "homogeneous pipe stages"
         )
     S = mesh.shape[pipe_axis]
-    if model.depth % S:
-        raise ValueError(
-            f"model.depth ({model.depth}) must be a multiple of the "
-            f"'{pipe_axis}' axis size ({S})"
-        )
-    V = model.depth // S
+    if boundaries is not None:
+        if not blocked:
+            raise ValueError(
+                f"{what}: planner boundaries use the blocked chunk "
+                "layout and cannot combine with interleave=True (the "
+                "round-robin placement has no contiguous stage ranges)")
+        boundaries = _validate_pp_boundaries(boundaries, S, model.depth, what)
+        counts = [boundaries[s + 1] - boundaries[s] for s in range(S)]
+        V = max(counts)
+    else:
+        if model.depth % S:
+            raise ValueError(
+                f"model.depth ({model.depth}) must be a multiple of the "
+                f"'{pipe_axis}' axis size ({S}) — or pass a pp plan, "
+                "whose non-uniform boundaries lift the divisibility "
+                "requirement"
+            )
+        V = model.depth // S
+        counts = None
 
     blk = DecoderBlock(
         model.num_heads, model.mlp_dim, dtype=model.dtype,
@@ -1348,11 +1382,15 @@ def _pp_validate_and_stage(model: "TransformerLM", mesh, pipe_axis: str, what: s
     def base_fn(p, x):
         return blk.apply({"params": p}, x, train=False)
 
+    if counts is not None and V > 1 and any(c != V for c in counts):
+        # non-uniform planner split: idle pad chunks cond-skipped per
+        # device off the static counts table
+        return S, V, chunk_stages(base_fn, counts=counts, axis=pipe_axis)
     return S, V, (base_fn if V == 1 or not blocked else chunk_stages(base_fn))
 
 
 def _pp_split_params(model: "TransformerLM", mesh, pipe_axis: str, S: int, V: int,
-                     placement: str = "blocked"):
+                     placement: str = "blocked", boundaries=None):
     """Shared splitter: full param tree -> ``{"outer", "stages"}`` with
     block trees stacked (``(S, V, ...)`` when V > 1) on a leading dim
     sharded over ``pipe_axis``.
@@ -1363,13 +1401,29 @@ def _pp_split_params(model: "TransformerLM", mesh, pipe_axis: str, S: int, V: in
     ``"interleaved"`` (device i's chunk c hosts block ``c·S + i`` — the
     round-robin layout ``pipeline_grads_1f1b(interleave=V)`` schedules).
     Within one placement the two schedules share the tree, so their
-    checkpoints/shardings are interchangeable."""
+    checkpoints/shardings are interchangeable.
+
+    Planner ``boundaries`` replace the uniform blocked grouping with
+    the plan's contiguous ranges; devices hosting fewer than
+    ``V = max(counts)`` blocks are padded with zero-param chunks the
+    counts-aware ``chunk_stages`` never executes (zero grads in, zero
+    updates out — the optimizer cannot move them)."""
     from ..parallel.pp import stack_stage_params
 
     def split_params(params):
         stages = [params[f"block{i}"] for i in range(model.depth)]
         outer = {k: v for k, v in params.items() if not k.startswith("block")}
-        if V > 1:
+        if boundaries is not None:
+            groups = [list(stages[boundaries[s]:boundaries[s + 1]])
+                      for s in range(S)]
+            if V > 1:
+                pad = jax.tree.map(jnp.zeros_like, stages[0])
+                groups = [g + [pad] * (V - len(g)) for g in groups]
+                stages = [jax.tree.map(lambda *xs: jnp.stack(xs), *g)
+                          for g in groups]
+            else:
+                stages = [g[0] for g in groups]
+        elif V > 1:
             if placement == "interleaved":
                 groups = [[stages[c * S + s] for c in range(V)] for s in range(S)]
             else:
@@ -1401,6 +1455,7 @@ def lm_pp(
     batch_axis: Optional[str] = None,
     num_microbatches: Optional[int] = None,
     remat: bool = False,
+    boundaries=None,
 ):
     """Pipeline-parallelize the LM: blocks ride the GPipe schedule.
 
@@ -1424,17 +1479,22 @@ def lm_pp(
     ``batch_axis`` composes data parallelism on a ``(data, pipe)`` mesh.
     Constraints: ``use_rope`` (positions live inside the blocks) and
     ``dropout == 0`` (no rng stream threads through the pipeline ticks).
+    ``boundaries`` (a planner's S+1 cut points, ``parallel/pp_plan.py``)
+    replaces the uniform block split with the plan's non-uniform stage
+    ranges — and lifts the ``depth % S == 0`` requirement.
     """
     from ..parallel.pp import pipeline_apply
 
-    S, V, stage_fn = _pp_validate_and_stage(model, mesh, pipe_axis, "lm_pp")
+    S, V, stage_fn = _pp_validate_and_stage(
+        model, mesh, pipe_axis, "lm_pp", boundaries=boundaries)
     fwd = pipeline_apply(
         stage_fn, mesh, axis=pipe_axis, num_microbatches=num_microbatches,
         batch_axis=batch_axis, remat=remat,
     )
     embed = nn.Embed(model.vocab, model.dim, dtype=model.dtype)
     ln = _norm_layer(model.norm, model.dtype, eps=model.norm_eps)
-    split_params = _pp_split_params(model, mesh, pipe_axis, S, V)
+    split_params = _pp_split_params(
+        model, mesh, pipe_axis, S, V, boundaries=boundaries)
 
     def loss_fn(params, model_state, batch, train: bool, rng=None):
         tokens = batch["tokens"]
@@ -1479,6 +1539,7 @@ def lm_pp_1f1b(
     mesh,
     pipe_axis: str = PIPE_AXIS,
     interleave: bool = False,
+    boundaries=None,
 ):
     """Pipeline-parallelize the LM on the hand-scheduled 1F1B schedule
     (``parallel.pp_1f1b``) instead of GPipe-via-AD (``lm_pp``).
@@ -1507,10 +1568,13 @@ def lm_pp_1f1b(
     parameterize the schedule, not the stage decomposition).
     Constraints are ``lm_pp``'s (rope, no dropout, no MoE) plus: no
     ``batch["mask"]`` support (the per-microbatch loss reads tokens
-    only).
+    only).  ``boundaries`` (planner cut points) selects a non-uniform
+    blocked split exactly as in ``lm_pp`` — the two schedules keep
+    sharing one split tree — and cannot combine with ``interleave``.
     """
     S, V, stage_fn = _pp_validate_and_stage(
-        model, mesh, pipe_axis, "lm_pp_1f1b", blocked=not interleave)
+        model, mesh, pipe_axis, "lm_pp_1f1b", blocked=not interleave,
+        boundaries=boundaries)
     embed = nn.Embed(model.vocab, model.dim, dtype=model.dtype)
     ln = _norm_layer(model.norm, model.dtype, eps=model.norm_eps)
 
@@ -1529,7 +1593,8 @@ def lm_pp_1f1b(
 
     return LMPipelineWiring(
         _pp_split_params(model, mesh, pipe_axis, S, V,
-                         placement="interleaved" if interleave else "blocked"),
+                         placement="interleaved" if interleave else "blocked",
+                         boundaries=boundaries),
         (stage_fn, embed_fn, head_fn),
         _pp_state_shardings(mesh, pipe_axis),
         V if interleave else 1,

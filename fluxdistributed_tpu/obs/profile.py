@@ -305,13 +305,23 @@ def collect_profile(task=None, registry: Optional[Registry] = None,
 
 # -- modeled vs measured bubble accounting ---------------------------------
 
-def modeled_bubble(stage_costs: Sequence[float], num_microbatches: int) -> float:
+def modeled_bubble(stage_costs: Sequence[float], num_microbatches: int,
+                   schedule: str = "1f1b") -> float:
     """Pipeline bubble fraction the schedule model predicts for these
     per-stage costs: steady state is bottlenecked by the most expensive
     stage, fill+drain add S-1 of its ticks, so utilization is
     ``M * mean(stage) / ((M + S - 1) * max(stage))`` and the bubble is
     one minus that.  Uniform stages reduce it to the classic
-    ``(S-1)/(M+S-1)``."""
+    ``(S-1)/(M+S-1)``.
+
+    ``schedule="zb"`` applies the ZB-H1 accounting (arXiv:2401.10241's
+    handcrafted variant, the form ``pp_1f1b``'s zero-bubble schedule
+    implements): the backward is split into input-grad (B) and
+    weight-grad (W) halves and W — which depends on nothing downstream —
+    fills the drain, shrinking the fill/drain term from
+    ``(S-1)·(t_F + t_B_full)`` to ``(S-1)·(t_F + t_B − t_W)``.  With the
+    recompute-from-ring cost split F:B:W ≈ 1:1:1 that is one third of
+    the 1F1B term, so uniform stages reduce to ``(S-1)/(3M + S-1)``."""
     S = len(stage_costs)
     if S < 1:
         return 0.0
@@ -320,22 +330,34 @@ def modeled_bubble(stage_costs: Sequence[float], num_microbatches: int) -> float
         return 0.0
     mean = sum(stage_costs) / S
     M = num_microbatches
-    return 1.0 - (M * mean) / ((M + S - 1) * mx)
+    drain = (S - 1) / 3.0 if schedule == "zb" else float(S - 1)
+    return 1.0 - (M * mean) / ((M + drain) * mx)
 
 
-def stage_costs_from_static(model_costs: dict, S: int) -> List[float]:
+def stage_costs_from_static(model_costs: dict, S: int,
+                            boundaries: Optional[Sequence[int]] = None,
+                            ) -> List[float]:
     """Split a profile's per-layer static costs into S contiguous stage
-    cost sums the way ``lm_pp`` places them: ``depth`` uniform blocks
-    dealt round-floor with the remainder on the leading stages, the
-    outer (embed + head) cost split between first and last stage."""
+    cost sums.  Default placement is the way ``lm_pp`` places them:
+    ``depth`` uniform blocks dealt round-floor with the remainder on the
+    leading stages; pass a planner's ``boundaries`` (S+1 cut points) to
+    model a non-uniform split instead.  The outer (embed + head) cost is
+    split between first and last stage either way, and an explicit
+    ``static.model.blocks`` per-block list (skewed producers) takes
+    precedence over the homogeneous depth-difference ``block`` cost."""
+    from ..parallel.pp_plan import stage_costs_for, uniform_boundaries
+
     depth = int(model_costs["depth"])
-    block = float(model_costs["block"]["flops"])
+    blocks = model_costs.get("blocks")
+    if blocks:
+        block_costs = [float(b["flops"]) for b in blocks]
+    else:
+        block_costs = [float(model_costs["block"]["flops"])] * depth
     outer = float(model_costs["outer"]["flops"])
-    per_stage = [(depth // S + (1 if i < depth % S else 0)) * block
-                 for i in range(S)]
-    per_stage[0] += outer / 2
-    per_stage[-1] += outer / 2
-    return per_stage
+    if boundaries is None:
+        boundaries = uniform_boundaries(depth, S)
+    return list(stage_costs_for(block_costs, boundaries,
+                                (outer / 2, outer / 2)))
 
 
 def bubble_report(profile: Profile) -> List[dict]:
@@ -357,27 +379,53 @@ def bubble_report(profile: Profile) -> List[dict]:
         raise ValueError(
             "bubble accounting needs >= 2 measured M rows in the "
             "artifact (run benchmarks/pp_bubble.py --profile-out first)")
-    ms = [float(r["M"]) for r in rows]
-    ts = [float(r["step_ms"]) for r in rows]
-    n = len(rows)
-    mean_m, mean_t = sum(ms) / n, sum(ts) / n
-    denom = sum((m - mean_m) ** 2 for m in ms)
-    a = (sum((m - mean_m) * (t - mean_t) for m, t in zip(ms, ts)) / denom
-         if denom else 0.0)
-    b = mean_t - a * mean_m
+    # rows may mix configurations (uniform vs planned splits, 1f1b vs
+    # zb) — the linear fit only makes sense within one configuration,
+    # so group on the row tags (absent tags = the artifact's single
+    # pre-planner configuration, one group)
+    default_sched = (profile.meta or {}).get("schedule")
+    groups: Dict[tuple, list] = {}
+    for r in rows:
+        key = (r.get("schedule", default_sched),
+               tuple(r["boundaries"]) if r.get("boundaries") else None)
+        groups.setdefault(key, []).append(r)
     model_costs = (profile.static or {}).get("model")
     out = []
-    for r, t in zip(rows, ts):
-        S, M = int(r["S"]), int(r["M"])
-        stages = (stage_costs_from_static(model_costs, S)
-                  if model_costs else [1.0] * S)
-        measured = min(max(1.0 - (a * M) / t, 0.0), 1.0) if t > 0 else 0.0
-        out.append({
-            "M": M, "S": S,
-            "step_ms": round(t, 2),
-            "modeled_bubble": round(modeled_bubble(stages, M), 4),
-            "measured_bubble": round(measured, 4),
-            "fit_ms_per_microbatch": round(a, 4),
-            "fit_fixed_ms": round(b, 4),
-        })
+    for (sched, bounds), grp in groups.items():
+        if len(grp) < 2:
+            raise ValueError(
+                f"bubble accounting needs >= 2 measured M rows per "
+                f"configuration; (schedule={sched}, boundaries={bounds}) "
+                "has one — extend the M sweep")
+        ms = [float(r["M"]) for r in grp]
+        ts = [float(r["step_ms"]) for r in grp]
+        n = len(grp)
+        mean_m, mean_t = sum(ms) / n, sum(ts) / n
+        denom = sum((m - mean_m) ** 2 for m in ms)
+        a = (sum((m - mean_m) * (t - mean_t)
+                 for m, t in zip(ms, ts)) / denom if denom else 0.0)
+        b = mean_t - a * mean_m
+        for r, t in zip(grp, ts):
+            S, M = int(r["S"]), int(r["M"])
+            stages = (stage_costs_from_static(model_costs, S,
+                                              boundaries=bounds)
+                      if model_costs else [1.0] * S)
+            measured = (min(max(1.0 - (a * M) / t, 0.0), 1.0)
+                        if t > 0 else 0.0)
+            row = {
+                "M": M, "S": S,
+                "step_ms": round(t, 2),
+                "modeled_bubble": round(
+                    modeled_bubble(
+                        stages, M,
+                        schedule="zb" if sched == "zb" else "1f1b"), 4),
+                "measured_bubble": round(measured, 4),
+                "fit_ms_per_microbatch": round(a, 4),
+                "fit_fixed_ms": round(b, 4),
+            }
+            if sched is not None:
+                row["schedule"] = sched
+            if bounds is not None:
+                row["boundaries"] = list(bounds)
+            out.append(row)
     return out

@@ -31,6 +31,8 @@ from .ep import (
 )
 from .pp import make_train_step_pp, pipeline_apply, stack_stage_params, switch_stage
 from .pp_1f1b import build_schedule, make_train_step_1f1b, pipeline_grads_1f1b
+from . import pp_plan
+from .pp_plan import PipelinePlan, plan_from_model, plan_from_profile, plan_stages
 from .tp import lm_tp_rules, make_train_step_tp, param_specs, shard_state, vit_tp_rules
 
 __all__ = [
@@ -75,6 +77,11 @@ __all__ = [
     "make_train_step_1f1b",
     "stack_stage_params",
     "switch_stage",
+    "PipelinePlan",
+    "plan_stages",
+    "plan_from_profile",
+    "plan_from_model",
+    "pp_plan",
     "moe_apply",
     "router_dispatch_expert_choice",
     "router_dispatch",

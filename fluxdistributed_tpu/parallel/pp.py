@@ -80,7 +80,8 @@ def _accepts_stage(fn: Callable) -> bool:
     return len(required) >= 3
 
 
-def chunk_stages(stage_fn: Callable) -> Callable:
+def chunk_stages(stage_fn: Callable, counts=None,
+                 axis: str = PIPE_AXIS) -> Callable:
     """Host V consecutive logical stages per pipe device (blocked virtual
     pipeline): wraps ``stage_fn`` to ``lax.scan`` over a leading chunk
     dim in its params, so device *s* applies logical stages
@@ -91,16 +92,50 @@ def chunk_stages(stage_fn: Callable) -> Callable:
     pipe axis (``stack_stage_params`` of per-device ``(V, ...)`` trees
     does exactly that).
 
-    Under this GPipe schedule, blocked placement keeps the bubble at
+    ``counts`` (one int per pipe device) turns on NON-uniform splits —
+    the profile-guided planner's output (``parallel/pp_plan.py``):
+    every device's param slab is padded to ``max(counts)`` chunks, and
+    device *i* applies only its first ``counts[i]`` per tick — the rest
+    are ``lax.cond``-skipped identity chunks (their zero params are
+    never touched, their grads stay zero).  The counts table is
+    trace-time STATIC (baked like the 1F1B schedule tables, read per
+    device via ``axis_index``), so a plan change recompiles exactly
+    like a depth change would — it never enters a jit argument
+    signature, and within a run there is still exactly ONE compile.
+
+    Under the GPipe schedule, blocked placement keeps the bubble at
     ``(S-1)/(M+S-1)`` ticks (each tick is V stage-times) — the same
     relative bubble as a V-times-deeper per-device stage, which is what
-    it is.  Interleaved (Megatron 1F1B) placement is not implemented:
-    the backward here is AD-derived from the forward scan, so there is
+    it is.  Interleaved (Megatron 1F1B) placement is not implemented
+    here: the backward is AD-derived from the forward scan, so there is
     no hand-written 1F1B schedule to interleave.
     """
+    if counts is None:
+        def fn(params, x):
+            h, _ = jax.lax.scan(lambda h, p: (stage_fn(p, h), None), x, params)
+            return h
+
+        return fn
+
+    import numpy as np
+
+    counts_arr = np.asarray(list(counts), np.int32)
 
     def fn(params, x):
-        h, _ = jax.lax.scan(lambda h, p: (stage_fn(p, h), None), x, params)
+        mine = jnp.take(jnp.asarray(counts_arr), jax.lax.axis_index(axis))
+        vmax = jax.tree.leaves(params)[0].shape[0]
+
+        def body(h, pc):
+            p, c = pc
+            h2 = jax.lax.cond(
+                c < mine,
+                lambda p_, h_: stage_fn(p_, h_),
+                lambda p_, h_: h_,
+                p, h)
+            return h2, None
+
+        h, _ = jax.lax.scan(
+            body, x, (params, jnp.arange(vmax, dtype=jnp.int32)))
         return h
 
     return fn

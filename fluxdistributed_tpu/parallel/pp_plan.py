@@ -1,0 +1,536 @@
+"""Profile-guided pipeline planner: auto-place stage boundaries from
+per-block costs.
+
+``pp_1f1b``/``lm_pp`` place stage boundaries by uniform layer count —
+fine for a homogeneous decoder stack in isolation, but the real program
+is not homogeneous: the embedding runs on pipe device 0 and the
+final-norm/logits/loss on device S-1 (both INSIDE the 1F1B schedule,
+per microbatch), and a profile may reveal further skew (MoE-free blocks
+vs future heterogeneous stacks, measured straggling).  The lockstep
+schedule is bottlenecked by its most expensive stage: every tick costs
+``max(stage)``, so utilization is ``M·mean/( (M+S-1)·max )`` and any
+imbalance is paid on EVERY microbatch, not just in the bubble
+(arXiv:2204.10562's planning argument; arXiv:2412.14374 reaches the
+same conclusion for MPMD pipelines).
+
+This module closes ROADMAP item 4's loop over the PR-9 data layer
+(:mod:`..obs.profile`): consume a cost-profile artifact — or fresh
+static costs straight from the staged-out model — and emit a
+:class:`PipelinePlan`: non-uniform stage boundaries minimizing the
+modeled max-stage cost under an optional per-device memory budget, with
+the modeled bubble (planned AND uniform, so the win is auditable) and a
+per-stage memory estimate attached.  ``prepare_training(spmd="pp_1f1b",
+pp_plan=...)`` and ``bin/driver.py --pp-plan PATH|auto`` execute the
+boundaries as static non-uniform ``chunk_stages`` splits (padded to the
+max chunk count per device, idle chunks ``lax.cond``-skipped — ONE
+compile, the plan never enters a jit signature).
+
+Partitioning is exact, not greedy: a DP over contiguous partitions
+minimizing ``(max stage cost, Σ stage_cost²)`` lexicographically — the
+secondary term makes flat costs degrade to the uniform split exactly
+(same boundaries, same compiled program), so the planner can be left on
+everywhere.  Cross-topology reuse of profile-derived plans is rejected
+through the same fingerprint recipe as the AOT keys
+(:func:`..compilation.topology_fingerprint`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from typing import Any, Optional, Sequence, Tuple
+
+__all__ = [
+    "PipelinePlan",
+    "PlanError",
+    "plan_stages",
+    "plan_from_profile",
+    "plan_from_model",
+    "resolve_plan",
+    "stage_costs_for",
+    "uniform_boundaries",
+]
+
+SCHEMA = "fdtpu-pp-plan/v1"
+
+
+class PlanError(ValueError):
+    """No feasible stage placement for the given costs/budget."""
+
+
+def uniform_boundaries(depth: int, S: int) -> Tuple[int, ...]:
+    """The uniform split's cut points: ``depth`` blocks dealt round-floor
+    with the remainder on the leading stages — exactly the layout
+    ``obs.profile.stage_costs_from_static`` models and ``lm_pp`` builds
+    when ``depth % S == 0``."""
+    counts = [depth // S + (1 if i < depth % S else 0) for i in range(S)]
+    out = [0]
+    for c in counts:
+        out.append(out[-1] + c)
+    return tuple(out)
+
+
+def stage_costs_for(block_costs: Sequence[float],
+                    boundaries: Sequence[int],
+                    outer: Tuple[float, float] = (0.0, 0.0)) -> Tuple[float, ...]:
+    """Per-stage cost sums for these cut points, with the outer costs
+    folded into the first/last stages (embed runs at logical stage 0,
+    head at the last — where the 1F1B schedule executes them)."""
+    S = len(boundaries) - 1
+    out = []
+    for s in range(S):
+        c = float(sum(block_costs[boundaries[s]:boundaries[s + 1]]))
+        if s == 0:
+            c += float(outer[0])
+        if s == S - 1:
+            c += float(outer[1])
+        out.append(c)
+    return tuple(out)
+
+
+@dataclasses.dataclass
+class PipelinePlan:
+    """Planner output: where to cut the stack, and what the model says
+    that placement buys.
+
+    ``boundaries`` — S+1 cut points (``boundaries[s]:boundaries[s+1]``
+    is stage s's block range); ``stage_costs`` — modeled per-stage cost
+    at those cuts (outer folded in); ``modeled_bubble`` vs
+    ``uniform_bubble`` — the schedule model's bubble fraction for the
+    planned and the uniform split at ``num_microbatches``;
+    ``stage_bytes`` — per-stage memory estimate (stage param bytes plus
+    the ``min(S, M)``-slot activation ring when the inputs allowed
+    estimating it); ``fingerprint`` — topology digest of the profile
+    the costs came from ("" for synthetic/explicit costs).
+    """
+
+    boundaries: Tuple[int, ...]
+    stage_costs: Tuple[float, ...]
+    modeled_bubble: float
+    uniform_bubble: float
+    num_microbatches: int
+    schedule: str = "1f1b"
+    stage_bytes: Tuple[float, ...] = ()
+    memory_budget: Optional[float] = None
+    fingerprint: str = ""
+    meta: dict = dataclasses.field(default_factory=dict)
+    schema: str = SCHEMA
+
+    def __post_init__(self):
+        self.boundaries = tuple(int(b) for b in self.boundaries)
+        self.stage_costs = tuple(float(c) for c in self.stage_costs)
+        self.stage_bytes = tuple(float(b) for b in self.stage_bytes)
+
+    @property
+    def S(self) -> int:
+        return len(self.boundaries) - 1
+
+    @property
+    def depth(self) -> int:
+        return self.boundaries[-1]
+
+    @property
+    def counts(self) -> Tuple[int, ...]:
+        """Blocks hosted per pipe device."""
+        return tuple(self.boundaries[s + 1] - self.boundaries[s]
+                     for s in range(self.S))
+
+    @property
+    def is_uniform(self) -> bool:
+        return self.boundaries == uniform_boundaries(self.depth, self.S)
+
+    # -- persistence (the planner report CI exports + --pp-plan loads) --
+    def save(self, path: str) -> str:
+        doc = dataclasses.asdict(self)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "PipelinePlan":
+        with open(path) as f:
+            doc = json.load(f)
+        schema = doc.get("schema")
+        if schema != SCHEMA:
+            raise ValueError(
+                f"{path}: not a {SCHEMA} artifact (schema={schema!r}) — "
+                "regenerate it with parallel.pp_plan")
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in doc.items() if k in fields})
+
+    def verify(self, mesh=None, tag: str = "") -> "PipelinePlan":
+        """Reject cross-topology reuse: a plan derived from a profile
+        measured elsewhere must not drive placement here (same recipe as
+        :meth:`..obs.profile.Profile.verify`).  Plans with no recorded
+        fingerprint (synthetic/explicit costs) pass — static FLOP ratios
+        are topology-free."""
+        if not self.fingerprint:
+            return self
+        from ..compilation import topology_fingerprint
+        from ..obs.profile import ProfileMismatch, describe_topology
+
+        current = topology_fingerprint(mesh=mesh, tag=tag)
+        if current != self.fingerprint:
+            raise ProfileMismatch(
+                f"pipeline plan fingerprint {self.fingerprint} does not "
+                f"match this process ({current}): the profile it was "
+                f"derived from describes different hardware (current "
+                f"topology {describe_topology(mesh)}) — re-plan from a "
+                "profile collected here")
+        return self
+
+    def verify_source_topology(self) -> "PipelinePlan":
+        """Re-check the fingerprint against the topology the SOURCE
+        profile recorded (``meta.topology_mesh``), rebuilt on this
+        process — the consuming run's own mesh may legitimately differ
+        (a ``(data, pipe)`` trainer consuming a pipe-only ``pp_bubble``
+        profile's plan), but the box must be the one the costs were
+        measured on.  A recorded mesh this process cannot rebuild is
+        exactly the cross-topology case — rejected with the same error
+        type."""
+        if not self.fingerprint:
+            return self
+        shape = (self.meta or {}).get("topology_mesh") or None
+        mesh = None
+        if shape:
+            from ..mesh import make_mesh
+            from ..obs.profile import ProfileMismatch
+
+            try:
+                mesh = make_mesh({k: int(v) for k, v in shape.items()})
+            except ValueError as e:
+                raise ProfileMismatch(
+                    f"pipeline plan was derived from a profile recorded "
+                    f"on mesh {shape}, which this process cannot rebuild "
+                    f"({e}) — re-plan from a profile collected here")
+        return self.verify(mesh)
+
+    def describe(self) -> str:
+        """One-paragraph human summary for driver/bench logs."""
+        mx = max(self.stage_costs) if self.stage_costs else 0.0
+        mem = (f", peak stage bytes {max(self.stage_bytes):.3e}"
+               if self.stage_bytes else "")
+        return (f"pp plan: S={self.S} depth={self.depth} "
+                f"M={self.num_microbatches} schedule={self.schedule} "
+                f"counts={list(self.counts)} max-stage={mx:.3e} "
+                f"bubble {self.modeled_bubble:.4f} "
+                f"(uniform {self.uniform_bubble:.4f}){mem}")
+
+
+def _partition(block_costs: Sequence[float], S: int,
+               outer: Tuple[float, float],
+               feasible) -> Tuple[int, ...]:
+    """Exact DP over contiguous partitions of ``depth`` blocks into S
+    stages minimizing ``(max stage cost, Σ stage cost²)``
+    lexicographically, restricted to ``feasible(s, i, j)`` segments
+    (stage s spanning blocks ``i:j``).  Every stage gets >= 1 block.
+    Returns boundaries or raises :class:`PlanError`."""
+    depth = len(block_costs)
+    prefix = [0.0]
+    for c in block_costs:
+        prefix.append(prefix[-1] + float(c))
+
+    def seg(s: int, i: int, j: int) -> float:
+        c = prefix[j] - prefix[i]
+        if s == 0:
+            c += outer[0]
+        if s == S - 1:
+            c += outer[1]
+        return c
+
+    INF = (math.inf, math.inf)
+    # best[s][j] = (max, sumsq) of the best partition of blocks [0, j)
+    # into stages 0..s; parent[s][j] = the chosen cut i
+    best = [[INF] * (depth + 1) for _ in range(S)]
+    parent = [[-1] * (depth + 1) for _ in range(S)]
+    for j in range(1, depth - S + 2):
+        if feasible(0, 0, j):
+            c = seg(0, 0, j)
+            best[0][j] = (c, c * c)
+            parent[0][j] = 0
+    for s in range(1, S):
+        # stage s ends at j; at least s blocks behind it, and enough
+        # blocks left for the S-1-s stages after it
+        for j in range(s + 1, depth - (S - 1 - s) + 1):
+            cand = INF
+            arg = -1
+            # descending i: on full ties (flat costs) the latest cut
+            # wins, which reproduces uniform_boundaries' remainder-on-
+            # leading-stages layout exactly
+            for i in range(j - 1, s - 1, -1):
+                prev = best[s - 1][i]
+                if math.isinf(prev[0]) or not feasible(s, i, j):
+                    continue
+                c = seg(s, i, j)
+                key = (max(prev[0], c), prev[1] + c * c)
+                if key < cand:
+                    cand, arg = key, i
+            best[s][j] = cand
+            parent[s][j] = arg
+    if math.isinf(best[S - 1][depth][0]):
+        raise PlanError(
+            f"no feasible placement of {depth} blocks over {S} stages "
+            "under the memory budget — raise the budget, shrink the "
+            "model, or add pipe devices")
+    bounds = [depth]
+    j = depth
+    for s in range(S - 1, -1, -1):
+        j = parent[s][j]
+        bounds.append(j)
+    return tuple(reversed(bounds))
+
+
+def plan_stages(
+    block_costs: Sequence[float],
+    S: int,
+    num_microbatches: int,
+    outer: Tuple[float, float] = (0.0, 0.0),
+    schedule: str = "1f1b",
+    block_bytes: Optional[Sequence[float]] = None,
+    outer_bytes: Tuple[float, float] = (0.0, 0.0),
+    activation_bytes: float = 0.0,
+    memory_budget: Optional[float] = None,
+    fingerprint: str = "",
+    meta: Optional[dict] = None,
+) -> PipelinePlan:
+    """Place stage boundaries for ``len(block_costs)`` blocks over S
+    pipe devices minimizing the modeled max-stage cost (ties broken
+    toward balance, so flat costs return the uniform split exactly).
+
+    ``outer = (embed_cost, head_cost)`` is folded into the first/last
+    stages — the reason the planner beats uniform even on a homogeneous
+    stack.  ``block_bytes``/``outer_bytes``/``activation_bytes`` feed
+    the per-stage memory estimate: stage params plus the 1F1B input
+    ring (``min(S, M)`` activation slots per hosted chunk is the
+    schedule's stash bound; ``activation_bytes`` is one microbatch
+    activation).  ``memory_budget`` (bytes per device) makes
+    over-budget segments infeasible instead of merely expensive.
+    """
+    from ..obs.profile import modeled_bubble
+
+    depth = len(block_costs)
+    if S < 1:
+        raise PlanError(f"need >= 1 stage, got {S}")
+    if depth < S:
+        raise PlanError(
+            f"{depth} blocks cannot fill {S} pipeline stages (every "
+            "stage needs >= 1 block)")
+    if num_microbatches < 1:
+        raise PlanError(
+            f"num_microbatches must be >= 1, got {num_microbatches}")
+    if any(c < 0 for c in block_costs):
+        raise PlanError("block costs must be non-negative")
+
+    bbytes = [float(b) for b in (block_bytes or [0.0] * depth)]
+    if len(bbytes) != depth:
+        raise PlanError(
+            f"block_bytes has {len(bbytes)} entries for {depth} blocks")
+    bprefix = [0.0]
+    for b in bbytes:
+        bprefix.append(bprefix[-1] + b)
+    ring = min(S, num_microbatches)
+
+    def stage_mem(s: int, i: int, j: int) -> float:
+        m = bprefix[j] - bprefix[i]
+        if s == 0:
+            m += outer_bytes[0]
+        if s == S - 1:
+            m += outer_bytes[1]
+        # one input ring per hosted chunk; non-uniform splits execute as
+        # V_max padded chunks, but idle chunks stash nothing live
+        return m + ring * (j - i) * activation_bytes
+
+    def feasible(s: int, i: int, j: int) -> bool:
+        return memory_budget is None or stage_mem(s, i, j) <= memory_budget
+
+    boundaries = _partition(block_costs, S, outer, feasible)
+    costs = stage_costs_for(block_costs, boundaries, outer)
+    uni = uniform_boundaries(depth, S)
+    uni_costs = stage_costs_for(block_costs, uni, outer)
+    return PipelinePlan(
+        boundaries=boundaries,
+        stage_costs=costs,
+        modeled_bubble=modeled_bubble(costs, num_microbatches,
+                                      schedule=schedule),
+        uniform_bubble=modeled_bubble(uni_costs, num_microbatches,
+                                      schedule=schedule),
+        num_microbatches=num_microbatches,
+        schedule=schedule,
+        stage_bytes=tuple(
+            stage_mem(s, boundaries[s], boundaries[s + 1])
+            for s in range(S)),
+        memory_budget=memory_budget,
+        fingerprint=fingerprint,
+        meta=dict(meta or {}),
+    )
+
+
+def plan_from_profile(profile, S: int, num_microbatches: int,
+                      schedule: str = "1f1b",
+                      memory_budget: Optional[float] = None,
+                      activation_bytes: float = 0.0,
+                      mesh=None) -> PipelinePlan:
+    """Plan from a cost-profile artifact (:class:`..obs.profile.Profile`).
+
+    Uses the artifact's per-block static costs — the explicit
+    ``static.model.blocks`` list when a producer recorded per-block
+    skew, else the depth-difference ``block`` cost replicated ``depth``
+    times — with the outer (embed + head) cost split between the end
+    stages.  Call :meth:`Profile.verify` before planning when the
+    artifact came from disk; the emitted plan carries the profile's
+    fingerprint so consumers re-check at load time.
+
+    The artifact does not record the model width, so the memory
+    estimate's activation-ring term must come from the caller:
+    ``activation_bytes`` is one microbatch activation (``mb × seqlen ×
+    dim × 4``; :func:`resolve_plan` derives it when it has the model).
+    Left at 0, a ``memory_budget`` bounds stage PARAM bytes only.
+    """
+    model_costs = (profile.static or {}).get("model")
+    if not model_costs:
+        raise PlanError(
+            "profile artifact has no static model costs "
+            "(static.model is null) — re-collect with a token batch "
+            "so lm_layer_costs can stage the model out")
+    depth = int(model_costs["depth"])
+    blocks = model_costs.get("blocks")
+    if blocks:
+        block_costs = [float(b["flops"]) for b in blocks]
+        block_bytes = [float(b["bytes"]) for b in blocks]
+    else:
+        block_costs = [float(model_costs["block"]["flops"])] * depth
+        block_bytes = [float(model_costs["block"]["bytes"])] * depth
+    outer_f = float(model_costs["outer"]["flops"])
+    outer_b = float(model_costs["outer"]["bytes"])
+    return plan_stages(
+        block_costs, S, num_microbatches,
+        outer=(outer_f / 2, outer_f / 2),
+        schedule=schedule,
+        block_bytes=block_bytes,
+        outer_bytes=(outer_b / 2, outer_b / 2),
+        activation_bytes=activation_bytes,
+        memory_budget=memory_budget,
+        fingerprint=profile.fingerprint,
+        meta={"source": "profile", "batch": model_costs.get("batch"),
+              "seqlen": model_costs.get("seqlen"),
+              "topology_mesh": (profile.topology or {}).get("mesh")},
+    )
+
+
+def resolve_plan(source: str, S: int, num_microbatches: int,
+                 schedule: str = "1f1b", model=None,
+                 batch_size: Optional[int] = None,
+                 seqlen: Optional[int] = None,
+                 memory_budget: Optional[float] = None,
+                 verify: bool = True) -> PipelinePlan:
+    """Resolve a ``--pp-plan``-style source — the ONE implementation
+    behind ``bin/driver.py --pp-plan`` and ``benchmarks/pp_bubble.py
+    --plan``, so the two entry points can never drift on what artifacts
+    they accept.
+
+    ``source`` is ``"auto"`` (fresh static costs from ``model`` at
+    ``batch_size``/``seqlen`` — the full per-data-row batch, which the
+    planner divides by M for the activation-ring estimate), a saved
+    plan JSON, or a cost-profile artifact (sniffed on the ``schema``
+    key).  ``verify=True`` re-checks profile-derived fingerprints
+    against this process via :meth:`PipelinePlan.verify_source_topology`
+    (raising :class:`..obs.profile.ProfileMismatch` on cross-topology
+    reuse); pass ``verify=False`` only for offline analysis of a
+    foreign artifact."""
+    if source == "auto":
+        if model is None or batch_size is None or seqlen is None:
+            raise PlanError(
+                "resolve_plan('auto') needs model, batch_size and seqlen "
+                "to stage fresh static costs")
+        plan = plan_from_model(
+            model, S, num_microbatches, batch_size=batch_size,
+            seqlen=seqlen, schedule=schedule, memory_budget=memory_budget)
+    else:
+        with open(source) as f:
+            doc = json.load(f)
+        if doc.get("schema") == SCHEMA:
+            plan = PipelinePlan.load(source)
+        else:
+            from ..obs.profile import Profile
+
+            # the artifact lacks the model width — derive the ring's
+            # activation term here when the caller supplied the model,
+            # so a memory_budget covers the documented ring bytes
+            dim = int(getattr(model, "dim", 0) or 0) if model else 0
+            act_bytes = (
+                float(max(batch_size // num_microbatches, 1)
+                      * seqlen * dim * 4)
+                if dim and batch_size and seqlen else 0.0)
+            plan = plan_from_profile(
+                Profile.load(source), S, num_microbatches,
+                schedule=schedule, memory_budget=memory_budget,
+                activation_bytes=act_bytes)
+        if verify:
+            plan.verify_source_topology()
+    # fail FAST on a plan that cannot drive this run — a saved plan for
+    # a different pipe axis or model must not survive resolution only
+    # to crash (after burned sweep/grant time) inside the model wiring
+    if plan.S != S:
+        raise PlanError(
+            f"plan places {plan.S} stages but this run's pipe axis has "
+            f"{S} — re-plan for this mesh")
+    if model is not None and plan.depth != int(getattr(model, "depth", 0)):
+        raise PlanError(
+            f"plan partitions {plan.depth} blocks but the model has "
+            f"depth {getattr(model, 'depth', 0)} — re-plan for this model")
+    return plan
+
+
+def plan_from_model(model, S: int, num_microbatches: int,
+                    batch_size: int, seqlen: int,
+                    schedule: str = "1f1b",
+                    memory_budget: Optional[float] = None) -> PipelinePlan:
+    """Plan from fresh static costs: stage the model out on this process
+    (:func:`..obs.profile.lm_layer_costs` — lowering only, nothing
+    compiles) and size the memory estimate exactly — per-block param
+    bytes from ``eval_shape`` of the real init, one-microbatch
+    activation bytes from the model's width.  The ``--pp-plan auto``
+    path."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..obs.profile import lm_layer_costs
+
+    costs = lm_layer_costs(model, batch_size, seqlen)
+    if costs is None:
+        raise PlanError(
+            f"{type(model).__name__} could not be staged out for layer "
+            "costs (lm_layer_costs returned None) — pass an explicit "
+            "profile artifact instead")
+    depth = int(costs["depth"])
+
+    def tree_bytes(tree) -> float:
+        return float(sum(
+            leaf.size * jnp.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree.leaves(tree)))
+
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, seqlen), jnp.int32), train=False))
+    params = variables["params"]
+    block_bytes = [tree_bytes(params[f"block{i}"]) for i in range(depth)]
+    outer_bytes = tree_bytes(
+        {k: v for k, v in params.items() if not k.startswith("block")})
+    mb = max(batch_size // num_microbatches, 1)
+    act_bytes = float(mb * seqlen * int(model.dim) * 4)  # f32 ring slots
+    return plan_stages(
+        [float(costs["block"]["flops"])] * depth, S, num_microbatches,
+        outer=(float(costs["outer"]["flops"]) / 2,
+               float(costs["outer"]["flops"]) / 2),
+        schedule=schedule,
+        block_bytes=block_bytes,
+        outer_bytes=(outer_bytes / 2, outer_bytes / 2),
+        activation_bytes=act_bytes,
+        memory_budget=memory_budget,
+        meta={"source": "model", "model": type(model).__name__,
+              "batch": int(batch_size), "seqlen": int(seqlen)},
+    )

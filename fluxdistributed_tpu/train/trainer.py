@@ -136,6 +136,8 @@ def prepare_training(
     steps_per_call: int = 1,
     num_microbatches: Optional[int] = None,
     pipeline_interleave: bool = False,
+    pipeline_schedule: str = "1f1b",
+    pp_plan=None,
     cache_dir: Optional[str] = None,
     aot: Optional[str] = None,
     warmup: bool = False,
@@ -191,6 +193,17 @@ def prepare_training(
     runtime sits behind a network tunnel or the host is slow; cadences
     in ``train`` (print/eval/checkpoint) then tick once per K steps.
     Supported for ``spmd='jit'``.
+
+    Pipeline knobs (``spmd="pp"``/``"pp_1f1b"``): ``num_microbatches``
+    sets M (default 2·S), ``pipeline_interleave`` the Megatron
+    round-robin virtual stages, ``pipeline_schedule="zb"`` the
+    zero-bubble B/W-split timetable (pp_1f1b only; bit-identical
+    gradients, W work fills the drain), and ``pp_plan`` a
+    :class:`~..parallel.pp_plan.PipelinePlan` (or saved-plan path)
+    whose profile-guided non-uniform stage boundaries replace the
+    uniform block split — cross-topology plans are rejected through
+    the profile fingerprint check, and a plan lifts the
+    ``depth % S == 0`` requirement.
 
     Cold-start controls (:mod:`fluxdistributed_tpu.compilation`):
 
@@ -271,10 +284,31 @@ def prepare_training(
                 "train(guard=GuardConfig(...))")
     if num_microbatches is not None and spmd not in ("pp", "pp_1f1b"):
         raise ValueError("num_microbatches requires spmd='pp' or 'pp_1f1b'")
+    if num_microbatches is not None and num_microbatches < 1:
+        # validated HERE with the other argument checks, before any
+        # pipeline-specific model wiring, so the error fires identically
+        # across spmd modes and model types
+        raise ValueError(
+            f"num_microbatches must be >= 1, got {num_microbatches}")
     if pipeline_interleave and spmd != "pp_1f1b":
         raise ValueError(
             "pipeline_interleave requires spmd='pp_1f1b' (the hand-written "
             "schedule; GPipe-via-AD cannot interleave)")
+    if pipeline_schedule not in ("1f1b", "zb"):
+        raise ValueError(
+            f"unknown pipeline_schedule {pipeline_schedule!r} "
+            "(pick '1f1b' or 'zb')")
+    if pipeline_schedule != "1f1b" and spmd != "pp_1f1b":
+        raise ValueError(
+            "pipeline_schedule='zb' requires spmd='pp_1f1b' (the zero-"
+            "bubble B/W split only exists in the hand-written schedule)")
+    if pp_plan is not None and spmd not in ("pp", "pp_1f1b"):
+        raise ValueError("pp_plan requires spmd='pp' or 'pp_1f1b'")
+    if pp_plan is not None and pipeline_interleave:
+        raise ValueError(
+            "pp_plan cannot combine with pipeline_interleave: planner "
+            "boundaries are contiguous block ranges, the interleaved "
+            "placement is round-robin")
     mesh = mesh or mesh_lib.data_mesh()
     init_draw = None
     # a data-axis-divisible init sample for the modes whose models
@@ -411,10 +445,28 @@ def prepare_training(
                 )
         S = mesh.shape[mesh_lib.PIPE_AXIS]
         n_data = mesh.shape[mesh_lib.DATA_AXIS]
-        if num_microbatches is not None and num_microbatches < 1:
-            raise ValueError(
-                f"num_microbatches must be >= 1, got {num_microbatches}")
         M = num_microbatches or 2 * S
+        # planner boundaries: accept a PipelinePlan or a saved plan
+        # artifact path; reject cross-topology plans (profile-derived
+        # fingerprints) and plans for a different stack/axis
+        boundaries = None
+        if pp_plan is not None:
+            from ..parallel.pp_plan import PipelinePlan
+
+            if isinstance(pp_plan, str):
+                pp_plan = PipelinePlan.load(pp_plan)
+            pp_plan.verify_source_topology()
+            if pp_plan.S != S:
+                raise ValueError(
+                    f"pp_plan places {pp_plan.S} stages but the "
+                    f"'{mesh_lib.PIPE_AXIS}' axis has {S} — re-plan for "
+                    "this mesh")
+            if pp_plan.depth != model.depth:
+                raise ValueError(
+                    f"pp_plan partitions {pp_plan.depth} blocks but the "
+                    f"model has depth {model.depth} — re-plan for this "
+                    "model")
+            boundaries = pp_plan.boundaries
         per_row = batch_size // n_data
         if batch_size % n_data or per_row % M:
             raise ValueError(
@@ -438,7 +490,7 @@ def prepare_training(
             step_fn = make_train_step_1f1b(
                 *w.fns, optimizer, mesh, num_microbatches=M,
                 batch_axis=mesh_lib.DATA_AXIS, interleave=w.interleave,
-                donate=donate,
+                donate=donate, schedule=pipeline_schedule,
             )(state)
             eval_run = pipeline_grads_1f1b(
                 *w.fns, mesh, num_microbatches=M,
@@ -458,7 +510,8 @@ def prepare_training(
             )
         else:
             split_params, pp_loss_fn, shardings_fn = lm_pp(
-                model, mesh, batch_axis=mesh_lib.DATA_AXIS, num_microbatches=M
+                model, mesh, batch_axis=mesh_lib.DATA_AXIS,
+                num_microbatches=M, boundaries=boundaries,
             )
             state = TrainState.create(split_params(params), optimizer)
             sh = shardings_fn(state)
@@ -469,11 +522,11 @@ def prepare_training(
                     donate=donate, state_shardings=sh, guard=guard,
                 )
             else:
-                w = lm_pp_1f1b(model, mesh)
+                w = lm_pp_1f1b(model, mesh, boundaries=boundaries)
                 step_fn = make_train_step_1f1b(
                     *w.fns, optimizer, mesh, num_microbatches=M,
                     batch_axis=mesh_lib.DATA_AXIS, interleave=w.interleave,
-                    donate=donate,
+                    donate=donate, schedule=pipeline_schedule,
                 )(state)
             # eval through the GPipe forward: same tree, same shardings
             eval_fn = make_eval_step(
@@ -652,11 +705,25 @@ def prepare_training(
             # to the compiled program, so a guarded step must never
             # load an unguarded executable (or vice versa) — while
             # guard-off runs keep their pre-existing tags byte-for-byte
+            # pipeline_schedule and the plan's boundaries both change
+            # the compiled program at identical argument shapes (zb
+            # adds W ticks + the cot stash; a plan re-pads the chunk
+            # scan), so they must split the AOT key — appended only
+            # when NON-default, so every pre-existing run keeps its
+            # tag byte-for-byte (same contract as the guard flag: a
+            # warm executable pool must survive this upgrade)
             tag = compilation.config_tag(
                 spmd, zero1, accum_steps, steps_per_call, donate, seed,
                 num_microbatches, pipeline_interleave, repr(model),
                 optimizer.name, optimizer.update, loss_fn, loss,
-                *(("guard",) if guard else ()))
+                *(("guard",) if guard else ()),
+                *((pipeline_schedule,) if pipeline_schedule != "1f1b"
+                  else ()),
+                # a UNIFORM plan builds the no-plan program exactly, so
+                # it must also share the no-plan AOT key
+                *((repr(pp_plan.boundaries),)
+                  if pp_plan is not None and not pp_plan.is_uniform
+                  else ()))
             task.step_fn = compilation.load_or_compile(
                 task.step_fn, (task.state, dummy),
                 directory=aot, name="train_step",

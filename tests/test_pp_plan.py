@@ -1,0 +1,330 @@
+"""Profile-guided pipeline planner + schedule-oracle property tests.
+
+Planner invariants: on a skewed synthetic cost profile the planner's
+boundaries give STRICTLY lower modeled bubble than uniform splits; on
+flat costs it degrades to the uniform layout exactly (same boundaries,
+same compiled program); memory budgets make placements infeasible
+rather than silently over-budget; artifacts round-trip and reject
+cross-topology reuse through the fingerprint check.
+
+Oracle invariants (:func:`~fluxdistributed_tpu.parallel.pp_1f1b._verify_placement`):
+every timetable the builder emits passes, over a randomized
+(S, M, V, schedule) grid including "zb" — and deliberately corrupted
+placements of every hazard class FAIL, because a proof that never
+fires proves nothing.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from fluxdistributed_tpu.obs.profile import (
+    Profile, ProfileMismatch, bubble_report, modeled_bubble,
+    stage_costs_from_static,
+)
+from fluxdistributed_tpu.parallel.pp_1f1b import (
+    _place, _verify_placement, build_schedule,
+)
+from fluxdistributed_tpu.parallel.pp_plan import (
+    PipelinePlan, PlanError, plan_stages, stage_costs_for,
+    uniform_boundaries,
+)
+
+
+# ---- partitioner ----
+
+@pytest.mark.parametrize("depth,s", [(8, 4), (6, 4), (9, 4), (7, 3), (16, 8)])
+def test_flat_costs_degrade_to_uniform(depth, s):
+    plan = plan_stages([1.0] * depth, s, 8)
+    assert plan.boundaries == uniform_boundaries(depth, s)
+    assert plan.is_uniform
+    assert plan.modeled_bubble == pytest.approx(plan.uniform_bubble)
+
+
+def test_skewed_profile_beats_uniform_modeled_bubble():
+    """The acceptance criterion: strictly lower modeled bubble than
+    uniform splits on a skewed synthetic cost profile."""
+    skews = [
+        [4, 1, 1, 1, 1, 1, 1, 4],          # heavy ends
+        [1, 1, 1, 1, 1, 1, 1, 9],          # one heavy tail block
+        [5, 1, 2, 1, 3, 1, 1, 2, 1, 1],    # irregular
+    ]
+    for costs in skews:
+        plan = plan_stages(costs, 4, 8)
+        assert plan.modeled_bubble < plan.uniform_bubble, (costs, plan)
+        # the planned max stage is never worse than uniform's
+        uni = stage_costs_for(costs, uniform_boundaries(len(costs), 4))
+        assert max(plan.stage_costs) <= max(uni)
+
+
+def test_outer_costs_thin_the_end_stages():
+    """Embed/head folded into the first/last stages is the reason the
+    planner wins even on a homogeneous stack."""
+    plan = plan_stages([1.0] * 8, 4, 8, outer=(2.0, 2.0))
+    assert plan.counts[0] < plan.counts[1]
+    assert plan.counts[-1] < plan.counts[-2]
+    assert plan.modeled_bubble < plan.uniform_bubble
+
+
+def test_planner_validation_and_memory_budget():
+    with pytest.raises(PlanError, match="cannot fill"):
+        plan_stages([1.0] * 3, 4, 8)
+    with pytest.raises(PlanError, match="num_microbatches"):
+        plan_stages([1.0] * 8, 4, 0)
+    with pytest.raises(PlanError, match="non-negative"):
+        plan_stages([1.0, -1.0, 1.0, 1.0], 2, 4)
+    # an impossible per-device budget is infeasible, not silently over
+    with pytest.raises(PlanError, match="memory budget"):
+        plan_stages([1.0] * 8, 4, 8, block_bytes=[100.0] * 8,
+                    memory_budget=10.0)
+    # a budget that rules out piling blocks on one device reshapes the
+    # partition instead of failing
+    plan = plan_stages([1.0] * 8, 4, 8, block_bytes=[100.0] * 8,
+                       memory_budget=300.0)
+    assert max(plan.counts) <= 3
+    assert all(b <= 300.0 for b in plan.stage_bytes)
+
+
+def test_plan_artifact_roundtrip_and_fingerprint_gate(tmp_path):
+    plan = plan_stages([2, 1, 1, 1, 1, 2], 3, 6, fingerprint="")
+    path = str(tmp_path / "plan.json")
+    plan.save(path)
+    back = PipelinePlan.load(path)
+    assert back.boundaries == plan.boundaries
+    assert back.stage_costs == plan.stage_costs
+    # no fingerprint -> topology-free, verify passes anywhere
+    assert back.verify() is back
+    assert back.verify_source_topology() is back
+    # a wrong-schema file is rejected with guidance
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        json.dump({"schema": "something-else"}, f)
+    with pytest.raises(ValueError, match="fdtpu-pp-plan/v1"):
+        PipelinePlan.load(bad)
+    # a fingerprint from ANOTHER topology is rejected
+    alien = plan_stages([1.0] * 6, 3, 6, fingerprint="0" * 16)
+    with pytest.raises(ProfileMismatch):
+        alien.verify()
+
+
+def test_plan_from_profile_uses_blocks_and_outer():
+    from fluxdistributed_tpu.parallel.pp_plan import plan_from_profile
+
+    prof = Profile(
+        fingerprint="",
+        topology={"mesh": {"pipe": 4}},
+        static={"model": {
+            "batch": 2, "seqlen": 8, "depth": 8,
+            "block": {"flops": 1.0, "bytes": 10.0},
+            "outer": {"flops": 4.0, "bytes": 40.0},
+            "total": {"flops": 12.0, "bytes": 120.0},
+        }},
+    )
+    plan = plan_from_profile(prof, 4, 8)
+    assert plan.depth == 8 and plan.S == 4
+    assert plan.counts[0] < plan.counts[1]  # outer thins stage 0
+    assert plan.meta["topology_mesh"] == {"pipe": 4}
+    # an explicit per-block skew list takes precedence
+    prof.static["model"]["blocks"] = [
+        {"flops": f, "bytes": 1.0}
+        for f in (6.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 6.0)]
+    prof.static["model"]["outer"] = {"flops": 0.0, "bytes": 0.0}
+    plan2 = plan_from_profile(prof, 4, 8)
+    assert plan2.modeled_bubble < plan2.uniform_bubble
+    assert plan2.counts[0] == 1 and plan2.counts[-1] == 1
+    # no static model costs -> actionable failure
+    with pytest.raises(PlanError, match="static.model"):
+        plan_from_profile(Profile(fingerprint=""), 4, 8)
+
+
+def test_resolve_plan_fails_fast_on_mismatch(tmp_path):
+    """A saved plan for a different pipe axis / model depth dies at
+    RESOLUTION with the actionable message — not later, inside the
+    model wiring, after sweep time was already burned."""
+    import types
+
+    from fluxdistributed_tpu.parallel.pp_plan import resolve_plan
+
+    path = str(tmp_path / "plan8.json")
+    plan_stages([1.0] * 16, 8, 8).save(path)
+    with pytest.raises(PlanError, match="re-plan for this mesh"):
+        resolve_plan(path, 4, 8)
+    path2 = str(tmp_path / "plan4.json")
+    plan_stages([1.0] * 16, 4, 8).save(path2)
+    with pytest.raises(PlanError, match="re-plan for this model"):
+        resolve_plan(path2, 4, 8, model=types.SimpleNamespace(depth=12))
+    # matching plan resolves fine
+    got = resolve_plan(path2, 4, 8, model=types.SimpleNamespace(depth=16))
+    assert got.boundaries == plan_stages([1.0] * 16, 4, 8).boundaries
+
+
+# ---- schedule model (obs.profile) ----
+
+def test_modeled_bubble_reduces_to_closed_forms():
+    S, M = 4, 8
+    assert modeled_bubble([1.0] * S, M) == pytest.approx(
+        (S - 1) / (M + S - 1))
+    assert modeled_bubble([1.0] * S, M, schedule="zb") == pytest.approx(
+        (S - 1) / (3 * M + S - 1))
+    assert modeled_bubble([1.0] * S, M, schedule="zb") < modeled_bubble(
+        [1.0] * S, M)
+    assert modeled_bubble([], 4) == 0.0
+    assert modeled_bubble([0.0, 0.0], 4) == 0.0
+
+
+def test_stage_costs_from_static_boundaries():
+    mc = {"depth": 8, "block": {"flops": 1.0}, "outer": {"flops": 4.0}}
+    uni = stage_costs_from_static(mc, 4)
+    assert uni == [4.0, 2.0, 2.0, 4.0]
+    planned = stage_costs_from_static(mc, 4, boundaries=(0, 1, 4, 7, 8))
+    assert planned == [3.0, 3.0, 3.0, 3.0]
+
+
+def test_bubble_report_groups_tagged_rows():
+    """Planned-vs-uniform and 1f1b-vs-zb rows in ONE artifact fit per
+    configuration, and each group gets its own schedule model."""
+    mc = {"depth": 8, "block": {"flops": 1.0}, "outer": {"flops": 4.0}}
+    rows = []
+    for sched, a, b in (("1f1b", 4.0, 12.0), ("zb", 5.0, 4.0)):
+        for bounds in (None, [0, 1, 4, 7, 8]):
+            for M in (4, 8, 16):
+                r = {"M": M, "S": 4, "step_ms": a * M + b,
+                     "schedule": sched}
+                if bounds:
+                    r["boundaries"] = bounds
+                rows.append(r)
+    prof = Profile(fingerprint="", static={"model": mc},
+                   measured={"pp_rows": rows})
+    rep = bubble_report(prof)
+    assert len(rep) == len(rows)
+    by_key = {}
+    for r in rep:
+        by_key.setdefault(
+            (r["schedule"], bool(r.get("boundaries")), r["M"]), r)
+    # planted linear rows -> the fit recovers each group's own (a, b)
+    for r in rep:
+        want_a = 4.0 if r["schedule"] == "1f1b" else 5.0
+        assert r["fit_ms_per_microbatch"] == pytest.approx(want_a)
+    # planned boundaries change the MODELED column within a schedule
+    assert (by_key[("1f1b", True, 8)]["modeled_bubble"]
+            < by_key[("1f1b", False, 8)]["modeled_bubble"])
+    # zb's drain term is a third of 1f1b's at the same stage costs
+    assert (by_key[("zb", False, 8)]["modeled_bubble"]
+            < by_key[("1f1b", False, 8)]["modeled_bubble"])
+    # a one-row configuration cannot be fitted -> actionable error
+    prof.measured["pp_rows"] = rows[:3] + [
+        {"M": 4, "S": 4, "step_ms": 9.0, "schedule": "solo"}]
+    with pytest.raises(ValueError, match="per configuration"):
+        bubble_report(prof)
+
+
+# ---- the dependency oracle, property-tested ----
+
+@pytest.mark.parametrize("seed", range(6))
+def test_oracle_grid_randomized(seed):
+    """Every timetable the builder emits passes its own oracle (the
+    builder calls it) AND satisfies the count/exclusivity invariants,
+    over a randomized (S, M, V, schedule) grid."""
+    rng = np.random.default_rng(seed)
+    for _ in range(6):
+        S = int(rng.integers(2, 7))
+        M = int(rng.integers(1, 13))
+        V = int(rng.integers(1, 4))
+        schedule = ("1f1b", "zb")[int(rng.integers(0, 2))]
+        sched = build_schedule(S, M, V, schedule=schedule)
+        assert (sched.is_fwd.sum(axis=0) == V * M).all()
+        assert (sched.is_bwd.sum(axis=0) == V * M).all()
+        assert not (sched.is_fwd & sched.is_bwd).any()
+        if schedule == "zb":
+            assert (sched.is_w.sum(axis=0) == V * M).all()
+            assert not (sched.is_w & (sched.is_fwd | sched.is_bwd)).any()
+            busy = 3 * V * M
+        else:
+            assert not sched.is_w.any()
+            busy = 2 * V * M
+        assert (sched.busy_per_device() == busy).all()
+        assert (sched.idle_ticks == sched.ticks - busy).all()
+        assert 0.0 < sched.utilization <= 1.0
+
+
+def _fresh(S, M, V, schedule):
+    ring = min(S, M)
+    placed = _place(S, M, V, ring, 1, "bfw" if schedule == "zb" else "bfirst",
+                    zb=schedule == "zb")
+    assert placed is not None
+    fdone, bdone, wdone, _t, _mif = placed
+    return ring, fdone, bdone, wdone
+
+
+@pytest.mark.parametrize("schedule", ["1f1b", "zb"])
+def test_oracle_fires_on_corrupted_placements(schedule):
+    """Feed the oracle deliberately corrupted placements of every
+    hazard class — each must raise, naming the violation."""
+    S, M, V = 4, 6, 1
+
+    def corrupt(mutate, match):
+        ring, fdone, bdone, wdone = _fresh(S, M, V, schedule)
+        mutate(fdone, bdone, wdone)
+        with pytest.raises(RuntimeError, match=match):
+            _verify_placement(S, M, V, ring, 1, fdone, bdone, wdone)
+
+    # activation arriving after its consumer fired
+    corrupt(lambda f, b, w: f[1].__setitem__(
+        0, [f[2][0][m] + 1 for m in range(M)]), "act order|act latch")
+    # backward placed before its own forward
+    corrupt(lambda f, b, w: b[2][0].__setitem__(1, f[2][0][1] - 1),
+            "before its own forward|cot order|cot latch")
+    # ring slot reused while its occupant is still in flight
+    def ring_violation(f, b, w):
+        retire = w if schedule == "zb" else b
+        f[0][0][min(S, M)] = retire[0][0][0] - 1
+    corrupt(ring_violation, "ring slot|act")
+    if schedule == "zb":
+        # weight-grad before its input-grad
+        corrupt(lambda f, b, w: w[1][0].__setitem__(2, b[1][0][2] - 1),
+                "weight-grad before")
+        # cot stash overwritten before its W consumed it
+        def stash_violation(f, b, w):
+            w[0][0][0] = b[0][0][min(S, M)] + 1
+        corrupt(stash_violation, "cot stash|ring slot")
+
+
+def test_oracle_passes_valid_placements_directly():
+    for schedule in ("1f1b", "zb"):
+        ring, fdone, bdone, wdone = _fresh(4, 6, 1, schedule)
+        _verify_placement(4, 6, 1, ring, 1, fdone, bdone, wdone)
+
+
+# ---- schedule rendering (per-device idle, zb cells, no truncation) ----
+
+def test_render_idle_counts_and_zb_cells():
+    s = build_schedule(4, 8)
+    text = s.render()
+    assert "idle=6" in text and "S=4 M=8 V=1 T=22" in text
+    z = build_schedule(4, 8, schedule="zb")
+    zt = z.render()
+    assert zt.startswith("ZB schedule:")
+    assert "W0" in zt and "idle=" in zt
+    # V > 1 interleaved layouts render in FULL by default (no silent
+    # truncation), chunk-qualified cells included
+    wide = build_schedule(4, 16, 2, schedule="zb")
+    full = wide.render()
+    assert "more ticks" not in full
+    assert "w1:" in full and "f1:" in full
+    # explicit truncation still available
+    assert "more ticks" in wide.render(max_ticks=10)
+
+
+def test_zb_fills_the_drain():
+    """The point of zb: strictly fewer idle ticks than 1f1b at the same
+    shape, with the drain dominated by W work, not waiting."""
+    for S, M in ((4, 8), (8, 8), (4, 16)):
+        zb = build_schedule(S, M, schedule="zb")
+        base = build_schedule(S, M)
+        assert int(zb.idle_ticks.max()) < int(base.idle_ticks.max()), (S, M)
+        assert zb.utilization > base.utilization
+        # the final ticks of device 0 are W work in zb (the drain is
+        # filled), where 1f1b leaves them idle
+        last_rows = zb.is_w[-3:, :]
+        assert last_rows.any()

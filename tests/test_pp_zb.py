@@ -1,0 +1,294 @@
+"""Zero-bubble pipeline schedule + planner execution: parity and wiring.
+
+The acceptance bar: ``schedule="zb"`` matches ``pp_1f1b`` loss/grads
+BIT-FOR-BIT on the 8-virtual-device CPU mesh (the B and W ticks re-run
+the same vjp on the same operands the joint backward used, so equality
+is exact, not approximate), the planner's non-uniform boundaries
+execute through the padded chunk scan at plain-model gradient parity,
+and both ride ``prepare_training``/``bin/driver.py`` end-to-end at ONE
+compile per schedule.
+
+Fast tier carries the toy-model bit-parity core plus every validation
+path; the LM-level matrices and the driver subprocess e2e live in the
+slow tier (compile-heavy).
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fluxdistributed_tpu import mesh as mesh_lib, optim
+from fluxdistributed_tpu.parallel.pp import stack_stage_params
+from fluxdistributed_tpu.parallel.pp_1f1b import pipeline_grads_1f1b
+from fluxdistributed_tpu.parallel.pp_plan import plan_stages
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+S = 4
+D = 12
+DIN = 6
+NCLS = 5
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return mesh_lib.make_mesh({"pipe": S})
+
+
+def stage_fn(params, x):
+    return x + jax.nn.gelu(x @ params["w"] + params["b"])
+
+
+def embed_fn(outer, xin):
+    return jnp.tanh(xin @ outer["w_in"])
+
+
+def head_fn(outer, y, labels):
+    logits = y @ outer["w_out"]
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+
+
+def _toy(key, v=1):
+    ks = jax.random.split(key, 2 + v * S)
+    outer = {
+        "w_in": jax.random.normal(ks[0], (DIN, D), jnp.float32) * 0.4,
+        "w_out": jax.random.normal(ks[1], (D, NCLS), jnp.float32) * 0.4,
+    }
+    logical = [
+        {"w": jax.random.normal(k, (D, D), jnp.float32) * 0.3,
+         "b": jnp.zeros((D,), jnp.float32)}
+        for k in ks[2:]
+    ]
+    return outer, logical
+
+
+def _bitwise_equal(a_tree, b_tree):
+    for a, b in zip(jax.tree.leaves(a_tree), jax.tree.leaves(b_tree)):
+        if np.asarray(a).tobytes() != np.asarray(b).tobytes():
+            return False
+    return True
+
+
+def test_zb_bit_parity_toy(mesh):
+    """The acceptance core: loss, stage grads, and outer grads from the
+    zb timetable are byte-identical to the 1F1B ones."""
+    outer, per_stage = _toy(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(0, 1, (12, DIN)).astype(np.float32))
+    labels = jnp.asarray(
+        np.eye(NCLS, dtype=np.float32)[rng.integers(0, NCLS, 12)])
+    stacked = stack_stage_params(per_stage, mesh)
+
+    outs = {}
+    for sched in ("1f1b", "zb"):
+        run = pipeline_grads_1f1b(
+            stage_fn, embed_fn, head_fn, mesh, num_microbatches=6,
+            schedule=sched)
+        outs[sched] = jax.jit(run)(stacked, outer, x, labels)
+    l1, gs1, go1 = outs["1f1b"]
+    lz, gsz, goz = outs["zb"]
+    assert np.asarray(l1).tobytes() == np.asarray(lz).tobytes()
+    assert _bitwise_equal(gs1, gsz)
+    assert _bitwise_equal(go1, goz)
+
+
+def test_schedule_validation():
+    from fluxdistributed_tpu.parallel.pp_1f1b import build_schedule
+
+    with pytest.raises(ValueError, match="unknown pipeline schedule"):
+        build_schedule(4, 4, schedule="eager")
+
+
+def test_trainer_validation_surface():
+    """pipeline_schedule / pp_plan / hoisted microbatch checks all fire
+    BEFORE any pipeline-specific model wiring."""
+    from fluxdistributed_tpu.data import SyntheticTextDataset
+    from fluxdistributed_tpu.models import SimpleCNN
+    from fluxdistributed_tpu.train import prepare_training
+
+    ds = SyntheticTextDataset(vocab=16, seqlen=8)
+    cnn = SimpleCNN(num_classes=4)
+    # hoisted ordering: an invalid microbatch count reports AS ITSELF,
+    # not as a downstream model-type error, for every pipeline mode
+    for spmd in ("pp", "pp_1f1b"):
+        with pytest.raises(ValueError, match="must be >= 1"):
+            prepare_training(
+                cnn, ds, optim.adam(1e-3), batch_size=8, spmd=spmd,
+                num_microbatches=0, input_shape=(8, 8, 3))
+    with pytest.raises(ValueError, match="unknown pipeline_schedule"):
+        prepare_training(
+            cnn, ds, optim.adam(1e-3), batch_size=8, spmd="pp_1f1b",
+            pipeline_schedule="eager", input_shape=(8, 8, 3))
+    with pytest.raises(ValueError, match="requires spmd='pp_1f1b'"):
+        prepare_training(
+            cnn, ds, optim.adam(1e-3), batch_size=8, spmd="jit",
+            pipeline_schedule="zb", input_shape=(8, 8, 3))
+    with pytest.raises(ValueError, match="pp_plan requires"):
+        prepare_training(
+            cnn, ds, optim.adam(1e-3), batch_size=8, spmd="jit",
+            pp_plan=plan_stages([1.0] * 4, 2, 2),
+            input_shape=(8, 8, 3))
+    with pytest.raises(ValueError, match="pipeline_interleave"):
+        prepare_training(
+            cnn, ds, optim.adam(1e-3), batch_size=8, spmd="pp_1f1b",
+            pp_plan=plan_stages([1.0] * 4, 2, 2), pipeline_interleave=True,
+            input_shape=(8, 8, 3))
+
+
+def test_lm_boundaries_validation(mesh):
+    from fluxdistributed_tpu.models.transformer_lm import (
+        TransformerLM, lm_pp, lm_pp_1f1b,
+    )
+
+    model = TransformerLM(
+        vocab=16, dim=16, depth=8, num_heads=2, mlp_dim=32,
+        dtype=jnp.float32, dropout=0.0)
+    with pytest.raises(ValueError, match="S\\+1"):
+        lm_pp(model, mesh, boundaries=(0, 4, 8))
+    with pytest.raises(ValueError, match="span the whole stack"):
+        lm_pp(model, mesh, boundaries=(0, 2, 4, 6, 7))
+    with pytest.raises(ValueError, match=">= 1 block"):
+        lm_pp(model, mesh, boundaries=(0, 4, 4, 6, 8))
+    with pytest.raises(ValueError, match="interleave"):
+        lm_pp_1f1b(model, mesh, interleave=True,
+                   boundaries=(0, 2, 4, 6, 8))
+    # a non-divisible depth WITHOUT a plan names the pp-plan escape hatch
+    odd = TransformerLM(
+        vocab=16, dim=16, depth=6, num_heads=2, mlp_dim=32,
+        dtype=jnp.float32, dropout=0.0)
+    with pytest.raises(ValueError, match="pp plan"):
+        lm_pp(odd, mesh)
+
+
+def test_trainer_plan_mismatch_rejected():
+    from fluxdistributed_tpu.data import SyntheticTextDataset
+    from fluxdistributed_tpu.models.transformer_lm import TransformerLM
+    from fluxdistributed_tpu.train import prepare_training
+
+    mesh2 = mesh_lib.make_mesh({"data": 2, "pipe": 4})
+    ds = SyntheticTextDataset(vocab=16, seqlen=8)
+    model = TransformerLM(
+        vocab=16, dim=16, depth=8, num_heads=2, mlp_dim=32,
+        dtype=jnp.float32, dropout=0.0)
+    with pytest.raises(ValueError, match="re-plan for this mesh"):
+        prepare_training(
+            model, ds, optim.adam(1e-3), mesh=mesh2, batch_size=16,
+            spmd="pp_1f1b", num_microbatches=4, topk=(),
+            pp_plan=plan_stages([1.0] * 8, 2, 4))
+    with pytest.raises(ValueError, match="re-plan for this model"):
+        prepare_training(
+            model, ds, optim.adam(1e-3), mesh=mesh2, batch_size=16,
+            spmd="pp_1f1b", num_microbatches=4, topk=(),
+            pp_plan=plan_stages([1.0] * 12, 4, 4))
+
+
+def test_trainer_planned_zb_e2e():
+    """prepare_training(pp_plan=..., pipeline_schedule="zb") on a
+    non-divisible depth (6 over 4 pipe devices): trains through the
+    full trainer surface at ONE compile, and the GPipe eval reads the
+    same planned split tree."""
+    from fluxdistributed_tpu.data import SyntheticTextDataset
+    from fluxdistributed_tpu.models.transformer_lm import TransformerLM
+    from fluxdistributed_tpu.train import prepare_training
+
+    mesh2 = mesh_lib.make_mesh({"data": 2, "pipe": 4})
+    ds = SyntheticTextDataset(vocab=32, seqlen=16, peak=0.95)
+    model = TransformerLM(
+        vocab=32, dim=32, depth=6, num_heads=2, mlp_dim=64,
+        dtype=jnp.float32, dropout=0.0)
+    plan = plan_stages([1.0] * 6, 4, 4, outer=(1.0, 1.0))
+    assert plan.counts == (1, 2, 2, 1)  # genuinely non-uniform
+    task = prepare_training(
+        model, ds, optim.adam(3e-3), mesh=mesh2, batch_size=16,
+        cycles=8, topk=(), spmd="pp_1f1b", num_microbatches=4,
+        pp_plan=plan, pipeline_schedule="zb",
+        val_dataset=ds, val_samples=8)
+    losses = []
+    for batch in task.loader:
+        task.state, m = task.step_fn(task.state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+    assert int(task.state.step) == 8
+    # ONE compile per schedule: the jit cache holds exactly one entry
+    assert task.step_fn._cache_size() == 1
+    loss, _ = task.eval_fn(task.state, task.val_batch)
+    assert np.isfinite(float(loss))
+
+
+# ---- slow tier: LM matrices + driver subprocess ----
+
+@pytest.mark.slow
+@pytest.mark.parametrize("m,v,bounds", [
+    (2, 1, None),            # M < S drain-heavy shape
+    (8, 1, None),
+    (8, 2, None),            # interleaved chunks
+    (4, 1, (0, 1, 3, 5, 6)),  # planned non-uniform split (depth 6)
+])
+def test_lm_zb_bit_parity_matrix(mesh, m, v, bounds):
+    """LM-level zb-vs-1f1b bit parity: real DecoderBlocks, tied
+    embeddings, chunked/planned splits."""
+    from fluxdistributed_tpu.models.transformer_lm import (
+        TransformerLM, lm_pp_1f1b,
+    )
+
+    if bounds is not None:
+        depth = bounds[-1]
+        interleave = False
+    else:
+        depth = v * S
+        interleave = v > 1
+    model = TransformerLM(
+        vocab=64, dim=32, depth=depth, num_heads=2, mlp_dim=64,
+        dtype=jnp.float32, dropout=0.0)
+    rng = np.random.default_rng(5)
+    toks = jnp.asarray(rng.integers(0, 64, (8, 16)).astype(np.int32))
+    params = model.init(jax.random.PRNGKey(0), toks[:1], train=False)["params"]
+    w = lm_pp_1f1b(model, mesh, interleave=interleave, boundaries=bounds)
+    sp = w.split_params(params)
+    outs = {}
+    for sched in ("1f1b", "zb"):
+        run = pipeline_grads_1f1b(
+            *w.fns, mesh, num_microbatches=m, interleave=w.interleave,
+            schedule=sched)
+        outs[sched] = jax.jit(run)(sp["stages"], sp["outer"], toks, toks)
+    (l1, gs1, go1), (lz, gsz, goz) = outs["1f1b"], outs["zb"]
+    assert np.asarray(l1).tobytes() == np.asarray(lz).tobytes()
+    assert _bitwise_equal(gs1, gsz) and _bitwise_equal(go1, goz)
+
+
+@pytest.mark.slow
+def test_driver_pp_plan_zb_e2e(tmp_path):
+    """bin/driver.py --pp-plan auto --pp-schedule zb end-to-end, then a
+    second run consuming the FIRST run's profile artifact as the plan
+    source (the artifact -> plan -> run workflow)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    prof = str(tmp_path / "prof.json")
+    base = [
+        sys.executable, os.path.join("bin", "driver.py"),
+        "--model", "lm_tiny", "--dataset", "synthetic-text",
+        "--batch-size", "8", "--seqlen", "32", "--cycles", "3",
+        "--print-every", "0", "--eval-every", "0",
+        "--platform", "cpu", "--local-devices", "4",
+        "--spmd", "pp_1f1b", "--pipe", "4", "--microbatches", "4",
+        "--pp-schedule", "zb",
+    ]
+    p = subprocess.run(
+        base + ["--pp-plan", "auto", "--profile-out", prof],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert p.returncode == 0, p.stderr[-1500:]
+    assert "pp plan: S=4" in p.stdout and "done: 3 steps" in p.stdout
+    # second run plans FROM the artifact (fingerprint-gated)
+    p2 = subprocess.run(
+        base + ["--pp-plan", prof],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert p2.returncode == 0, p2.stderr[-1500:]
+    assert "pp plan: S=4" in p2.stdout and "done: 3 steps" in p2.stdout
