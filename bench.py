@@ -217,6 +217,28 @@ def guard_stamp():
         return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def memory_stamp(state=None):
+    """The HBM stamp for the bench JSON: live per-device memory truth
+    (``device.memory_stats()`` through obs.memstats — bytes in use,
+    PEAK since process start, limit and the min headroom ratio) plus,
+    when the bench state is at hand, its exact static bytes (params /
+    optimizer state off the leaf shapes).  On CPU it reads
+    ``{"available": false}`` — unavailable, never fake zeros.  Like the
+    lint/guard stamps it never raises and rides success AND error JSON
+    (relayed through the child status file), so a dead hardware round
+    records the memory state at death — the difference between "the
+    grant expired" and "we were at 2% headroom when it OOMed"."""
+    try:
+        from fluxdistributed_tpu.obs import memstats
+
+        out = memstats.hbm_summary()
+        if state is not None:
+            out["static"] = memstats.state_bytes(state)
+        return out
+    except Exception as e:  # noqa: BLE001 — stamp is best-effort
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def lint_stamp():
     """The static-health stamp for the bench JSON: the AST-layer
     rule-count summary + new-vs-baseline count from the fdtpu-lint suite
@@ -496,6 +518,7 @@ def resumable_main(argv=None) -> int:
                 "aot_path": aot_path,
                 "lint": lint_stamp(),
                 "guard": guard_stamp(),
+                "memory": memory_stamp(state),
             }))
             return 0
 
@@ -523,6 +546,7 @@ def resumable_main(argv=None) -> int:
             "compile_cache_dir": cache_dir,
             "lint": lint_stamp(),
             "guard": guard_stamp(),
+            "memory": memory_stamp(state),
         }))
         return 0
     except BaseException as e:  # noqa: BLE001 — always emit the JSON line
@@ -544,6 +568,8 @@ def resumable_main(argv=None) -> int:
             "resumable": provenance(),
             "lint": lint_stamp(),
             "guard": guard_stamp(),
+            # memory state at death: live HBM peak when available
+            "memory": memory_stamp(),
         }))
         return 0
 
@@ -559,7 +585,7 @@ def _write_status(path, phase):
 
     try:
         payload = {"phase": phase, **compilation.compile_metrics(),
-                   "guard": guard_stamp()}
+                   "guard": guard_stamp(), "memory": memory_stamp()}
         tmp = f"{path}.tmp"
         with open(tmp, "w") as f:
             json.dump(payload, f)
@@ -630,6 +656,9 @@ def _measure():
         # robustness forensics: fault/watchdog/guard counters this
         # measurement accumulated (retries survived, stalls seen)
         "guard": guard_stamp(),
+        # HBM forensics: static state bytes + live per-device memory
+        # (peak included) when memory_stats() is live on this backend
+        "memory": memory_stamp(state),
         # planner paired row: uniform vs planned modeled bubble for a
         # production-shaped LM on this box's static costs
         "pp_plan": pp_plan_stamp(),
@@ -728,6 +757,9 @@ def main():
         # the CHILD's robustness counters at its last status snapshot —
         # a dead round records the faults/stalls it saw before dying
         "guard": status.get("guard", guard_stamp()),
+        # and the CHILD's memory state at its last snapshot — dead hw
+        # rounds record the HBM picture at death, not the parent's
+        "memory": status.get("memory", memory_stamp()),
     }
     # If a background probe loop has been retrying the chip (the r4+
     # availability workflow: benchmarks/hw_watch.sh, docs/benchmarks.md),

@@ -1,0 +1,267 @@
+#!/usr/bin/env python
+"""fdtpu-fit — the memory/comms fit checker: does variant X fit
+topology Z, and with how much headroom?
+
+    # sweep every registered variant through the REAL prepare_training/
+    # LMEngine builders, compile each once, and write the memory+comms
+    # report as a fdtpu-profile/v2 artifact:
+    python bin/fit.py --collect memcomms.profile.json --host-devices 8
+
+    # rank variants by HBM headroom under a budget (bytes per device;
+    # defaults to the live device bytes_limit when memory_stats() is
+    # available — on CPU you must pass --hbm-bytes):
+    python bin/fit.py --profile memcomms.profile.json --hbm-bytes 16e9
+
+    # gate on one variant ("does zero1 fit here?"):
+    python bin/fit.py --profile p.json --hbm-bytes 16e9 --require zero1
+
+    # memory-baseline workflow (the lint-baseline idiom): fail only on
+    # NEW regressions beyond the tolerance, update to accept:
+    python bin/fit.py --collect out.json --check
+    python bin/fit.py --collect out.json --update-baseline
+
+Exit codes: 0 = ok / informational, 1 = baseline check failed,
+2 = usage error, 3 = a --require'd variant does not fit.
+
+This is the precursor of ROADMAP item 3's auto-layout picker: the
+picker will consume the same per-variant ``peak_bytes`` + collective
+ledger this CLI ranks by hand today.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _bootstrap() -> None:
+    try:
+        import fluxdistributed_tpu  # noqa: F401
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--collect", metavar="OUT",
+                   help="sweep the registered variants (compile each "
+                        "once) and write the memory+comms report as a "
+                        "fdtpu-profile/v2 artifact")
+    p.add_argument("--variants", default=None,
+                   help="comma-separated variant subset for --collect "
+                        "(default: all registered)")
+    p.add_argument("--no-hlo", action="store_true",
+                   help="skip the compiled-HLO collective parse in "
+                        "--collect (jaxpr ledger only)")
+    p.add_argument("--host-devices", type=int, default=0,
+                   help="force N virtual CPU devices before jax init "
+                        "(the lint idiom — CI/laptops; 0 = use the "
+                        "real topology)")
+    p.add_argument("--profile", metavar="PATH",
+                   help="rank variants from an existing artifact "
+                        "instead of sweeping")
+    p.add_argument("--allow-mismatch", action="store_true",
+                   help="skip the topology-fingerprint gate when "
+                        "loading --profile (offline analysis of a "
+                        "foreign artifact only)")
+    p.add_argument("--hbm-bytes", type=float, default=None,
+                   help="per-device HBM budget in bytes (default: the "
+                        "live device bytes_limit; REQUIRED on backends "
+                        "without memory_stats, e.g. CPU)")
+    p.add_argument("--require", action="append", default=[],
+                   metavar="VARIANT",
+                   help="exit 3 unless this variant fits the budget "
+                        "(repeatable)")
+    p.add_argument("--check", action="store_true",
+                   help="fail (exit 1) on memory regressions vs the "
+                        "committed baseline")
+    p.add_argument("--baseline", default=None,
+                   help="memory-baseline JSON (default: "
+                        "fluxdistributed_tpu/analysis/"
+                        "memory_baseline.json)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="write the sweep's memory figures as the new "
+                        "baseline")
+    p.add_argument("--tolerance", type=float, default=None,
+                   help="override the baseline's regression tolerance "
+                        "(fraction, e.g. 0.5)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the ranking/check as one JSON object")
+    return p
+
+
+def default_baseline_path() -> str:
+    import fluxdistributed_tpu
+
+    return os.path.join(
+        os.path.dirname(os.path.abspath(fluxdistributed_tpu.__file__)),
+        "analysis", "memory_baseline.json")
+
+
+def _variant_memory(profile) -> dict:
+    """{variant: entry} with a memory dict, off a v2 artifact."""
+    return {name: entry
+            for name, entry in (profile.memory.get("variants") or {}).items()}
+
+
+def rank_variants(profile, budget: float | None) -> list:
+    """Headroom ranking rows: one per variant with a memory model,
+    sorted most-headroom-first; variants whose memory_analysis was
+    unavailable rank last with fits=None (unknown is not 'fits')."""
+    rows = []
+    for name, entry in sorted(_variant_memory(profile).items()):
+        mem = entry.get("memory") if isinstance(entry, dict) else None
+        row = {"variant": name, "peak_bytes": None, "headroom_bytes": None,
+               "fits": None}
+        if mem:
+            row["peak_bytes"] = int(mem["peak_bytes"])
+            if budget is not None:
+                row["headroom_bytes"] = int(budget - mem["peak_bytes"])
+                row["fits"] = row["headroom_bytes"] >= 0
+        rows.append(row)
+    def _key(r):
+        if r["peak_bytes"] is None:
+            return (1, 0.0)  # unknowns last
+        if r["headroom_bytes"] is None:
+            return (0, float(r["peak_bytes"]))  # no budget: smallest first
+        return (0, -float(r["headroom_bytes"]))  # most headroom first
+
+    rows.sort(key=_key)
+    return rows
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    _bootstrap()
+    if not args.collect and not args.profile:
+        print("fit: pass --collect OUT and/or --profile PATH",
+              file=sys.stderr)
+        return 2
+    if args.host_devices:
+        from fluxdistributed_tpu.mesh import force_host_devices
+
+        force_host_devices(args.host_devices)
+
+    from fluxdistributed_tpu.obs import memstats
+    from fluxdistributed_tpu.obs.profile import (
+        Profile, ProfileMismatch, describe_topology)
+
+    if args.collect:
+        from fluxdistributed_tpu.compilation import topology_fingerprint
+
+        names = args.variants.split(",") if args.variants else None
+        report = memstats.variant_report(
+            names, include_hlo=not args.no_hlo)
+        prof = Profile(
+            fingerprint=topology_fingerprint(),
+            topology=describe_topology(),
+            memory={"state": None, "step": None,
+                    "variants": {n: {"memory": e.get("memory"),
+                                     "args_bytes": e.get("args_bytes"),
+                                     "source": e.get("source")}
+                                 for n, e in report.items()}},
+            comms={"step": {},
+                   "variants": {n: e.get("comms", {})
+                                for n, e in report.items()}},
+            meta={"producer": "bin/fit.py --collect"},
+        )
+        prof.save(args.collect)
+        print(f"fit: wrote {len(report)} variant(s) to {args.collect}")
+    else:
+        prof = Profile.load(args.profile)
+        if args.allow_mismatch:
+            print("fit: WARNING — topology gate skipped "
+                  "(--allow-mismatch); headroom figures describe the "
+                  f"artifact's topology {prof.topology}, not this box",
+                  file=sys.stderr)
+        else:
+            try:
+                prof.verify()
+            except ProfileMismatch as e:
+                raise SystemExit(f"fit: {e}")
+
+    rc = 0
+    # -- baseline workflow -------------------------------------------------
+    baseline_path = args.baseline or default_baseline_path()
+    current = _variant_memory(prof)
+    if args.update_baseline:
+        doc = memstats.build_baseline(
+            current,
+            tolerance=(args.tolerance if args.tolerance is not None
+                       else memstats.DEFAULT_TOLERANCE))
+        tmp = f"{baseline_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, baseline_path)
+        print(f"fit: wrote {len(doc['variants'])} variant baseline "
+              f"entr(ies) to {baseline_path}")
+        return 0
+    check = None
+    if args.check:
+        if not os.path.exists(baseline_path):
+            print(f"fit: baseline {baseline_path} not found",
+                  file=sys.stderr)
+            return 2
+        with open(baseline_path) as f:
+            base = json.load(f)
+        check = memstats.check_memory_baseline(
+            current, base, tolerance=args.tolerance)
+        for note in check["notes"]:
+            print(f"note: {note}")
+        for fail in check["failures"]:
+            print(f"FAIL: {fail}")
+        print(f"fit: baseline check — {check['checked']} variant(s) "
+              f"checked at tolerance {check['tolerance']}, "
+              f"{len(check['failures'])} failure(s)")
+        if check["failures"]:
+            rc = 1
+
+    # -- headroom ranking --------------------------------------------------
+    budget = args.hbm_bytes
+    if budget is None:
+        stats = memstats.hbm_device_stats()
+        limits = [d["bytes_limit"] for d in (stats or [])
+                  if d["bytes_limit"] > 0]
+        if limits:
+            budget = float(min(limits))
+    rows = rank_variants(prof, budget)
+    if args.as_json:
+        print(json.dumps({"budget_bytes": budget, "rows": rows,
+                          "check": check}, indent=2))
+    else:
+        if budget is None:
+            print("fit: no HBM budget — this backend reports no "
+                  "memory_stats (CPU); pass --hbm-bytes to rank "
+                  "fits (peak bytes still listed)")
+        else:
+            print(f"fit: per-device HBM budget {budget:.3e} bytes")
+        for r in rows:
+            peak = (f"{r['peak_bytes']:>14,}" if r["peak_bytes"]
+                    is not None else "   unavailable")
+            verdict = {True: "FITS", False: "DOES NOT FIT",
+                       None: "?"}[r["fits"]]
+            head = (f"  headroom {r['headroom_bytes']:,}"
+                    if r["headroom_bytes"] is not None else "")
+            print(f"  {r['variant']:<24} peak {peak}  {verdict}{head}")
+    for req in args.require:
+        row = next((r for r in rows if r["variant"] == req), None)
+        if row is None:
+            print(f"fit: --require {req}: unknown variant in this "
+                  f"artifact ({sorted(r['variant'] for r in rows)})",
+                  file=sys.stderr)
+            return 2
+        if row["fits"] is not True:
+            print(f"fit: --require {req}: peak "
+                  f"{row['peak_bytes']} bytes does NOT fit the "
+                  f"budget {budget} — pick a smaller variant or a "
+                  "bigger topology", file=sys.stderr)
+            return 3
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,8 +59,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "are given")
     p.add_argument("--variants", default=None,
                    help="comma-separated jaxpr variants to check "
-                        "(default: all registered — dp,zero1,fsdp,tp,"
-                        "pp_1f1b,context,serve)")
+                        "(default: all registered — see "
+                        "analysis.variants.variant_names())")
     p.add_argument("--execute", action="store_true",
                    help="also run one real step per variant under "
                         "jax.transfer_guard('disallow') (compiles; "

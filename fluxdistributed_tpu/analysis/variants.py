@@ -114,6 +114,35 @@ def _build_zero1() -> List[StepVariant]:
                      execute=True, spmd="jit", zero1=True)
 
 
+def _build_dp_shardmap() -> List[StepVariant]:
+    """The explicit-collectives DP step (``spmd="shard_map"``): per-
+    device grads + pmean written out as real collective primitives.
+    Registered so the comms ledger's jaxpr layer sees DP's semantic
+    signature — all-reduce ONLY — on a real ``prepare_training`` path
+    (the GSPMD dp variant's jaxpr carries no collectives; XLA inserts
+    them at compile time)."""
+    from .. import mesh as mesh_lib
+    from ..parallel import dp
+
+    model, ds = _image_setup()
+    return _prepared("dp_shardmap", model, ds, mesh_lib.data_mesh(8), dp,
+                     execute=True, spmd="shard_map")
+
+
+def _build_zero1_shardmap() -> List[StepVariant]:
+    """The explicit-collectives ZeRO-1 step (``spmd="shard_map",
+    zero1=True``): reduce-scatter → slice-local update → all-gather,
+    the arXiv:2004.13336 schedule written out.  Registered so the
+    comms ledger can assert the paper's signature (reduce-scatter +
+    all-gather where dp shows all-reduce) on the real path."""
+    from .. import mesh as mesh_lib
+    from ..parallel import zero1
+
+    model, ds = _image_setup()
+    return _prepared("zero1_shardmap", model, ds, mesh_lib.data_mesh(8),
+                     zero1, execute=True, spmd="shard_map", zero1=True)
+
+
 def _build_fsdp() -> List[StepVariant]:
     from .. import mesh as mesh_lib
     from ..parallel import fsdp
@@ -338,11 +367,15 @@ def _build_zero1_fused() -> List[StepVariant]:
 
 
 #: name → builder; the six parallelism variants the acceptance gate
-#: names, plus the serve engine's program pools (dense and paged, the
-#: paged Pallas/int8 fast path) and the fused ZeRO-1 update
+#: names (plus the explicit-collectives shard_map dp/zero1 pair the
+#: comms ledger pins its signatures on), the serve engine's program
+#: pools (dense and paged, the paged Pallas/int8 fast path) and the
+#: fused ZeRO-1 update
 VARIANT_BUILDERS: Dict[str, Callable[[], List[StepVariant]]] = {
     "dp": _build_dp,
+    "dp_shardmap": _build_dp_shardmap,
     "zero1": _build_zero1,
+    "zero1_shardmap": _build_zero1_shardmap,
     "zero1_fused": _build_zero1_fused,
     "fsdp": _build_fsdp,
     "tp": _build_tp,

@@ -18,6 +18,13 @@ modern names:
   several historical spellings; on a build with none of them the call
   warns and reports False instead of crashing, so cache enablement is
   always safe to leave on.
+* :func:`compiled_memory_analysis` / :func:`device_memory_stats` — the
+  memory-observability surface (``Compiled.memory_analysis()``,
+  ``Device.memory_stats()``) normalized to plain dicts, returning None
+  on builds/backends without it (CPU devices report no memory stats;
+  some jax builds lack ``memory_analysis`` entirely).  Every consumer
+  (obs.memstats, the HBM gauges, bin/fit.py) treats None as
+  "unavailable", never an error.
 
 No-ops on a modern toolchain.
 """
@@ -171,6 +178,62 @@ def configure_compilation_cache(
     except Exception:  # noqa: BLE001 — older layouts; memo just stays
         pass
     return True
+
+
+#: CompiledMemoryStats fields we normalize, in the XLA spelling minus
+#: the ``_size_in_bytes`` suffix.  ``peak`` is derived: the XLA
+#: approximation of live HBM while the program runs is arguments +
+#: outputs + temporaries minus the aliased (donated) overlap.
+_MEMORY_FIELDS = ("generated_code", "argument", "output", "alias", "temp")
+
+
+def compiled_memory_analysis(compiled) -> "dict | None":
+    """``Compiled.memory_analysis()`` normalized to plain int bytes:
+    ``{"generated_code_bytes", "argument_bytes", "output_bytes",
+    "alias_bytes", "temp_bytes", "peak_bytes"}``.
+
+    Returns None — never raises — when this jax build has no
+    ``memory_analysis``, the backend reports none (some plugin runtimes
+    return None), or the stats object lacks the expected fields.  A
+    missing memory model must degrade the observability artifact, not
+    kill the run producing it.
+    """
+    fn = getattr(compiled, "memory_analysis", None)
+    if fn is None:
+        return None
+    try:
+        st = fn()
+    except Exception:  # noqa: BLE001 — absence/unsupported, not failure
+        return None
+    if st is None:
+        return None
+    out = {}
+    for name in _MEMORY_FIELDS:
+        v = getattr(st, f"{name}_size_in_bytes", None)
+        if v is None and isinstance(st, dict):
+            v = st.get(f"{name}_size_in_bytes")
+        if v is None:
+            return None
+        out[f"{name}_bytes"] = int(v)
+    out["peak_bytes"] = (out["argument_bytes"] + out["output_bytes"]
+                         + out["temp_bytes"] - out["alias_bytes"])
+    return out
+
+
+def device_memory_stats(device) -> "dict | None":
+    """``Device.memory_stats()`` as a plain dict, or None when the
+    device does not report memory (CPU devices return None; older
+    plugin backends lack the method).  Never raises."""
+    fn = getattr(device, "memory_stats", None)
+    if fn is None:
+        return None
+    try:
+        st = fn()
+    except Exception:  # noqa: BLE001 — absence/unsupported, not failure
+        return None
+    if not st:
+        return None
+    return dict(st)
 
 
 def _install_tomllib() -> None:

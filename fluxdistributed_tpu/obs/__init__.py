@@ -10,7 +10,12 @@ One registry, four producers, three consumers:
 * :mod:`.jaxmon` — ``jax.monitoring`` listeners: compile counts/seconds
   and steady-state recompile flagging;
 * :mod:`.watchdog` — rolling-median heartbeat stall detection (+ the
-  OOM-skip counter);
+  OOM-skip counter and the HBM low-headroom alert);
+* :mod:`.memstats` — static per-program memory model
+  (``memory_analysis`` through the compat shim) + live per-device HBM
+  gauges (``fdtpu_hbm_*``, None-safe on CPU);
+* :mod:`.comms` — the collective-traffic ledger (jaxpr + compiled-HLO
+  collective counts/bytes per step per mesh axis);
 * :mod:`.server` — stdlib-HTTP ``/metrics`` + ``/healthz`` (the
   training-side analog of the LM server's endpoints).
 
@@ -26,7 +31,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
-from . import jaxmon
+from . import comms, jaxmon, memstats
+from .memstats import HbmGauges
 from .metrics import (
     Counter,
     Gauge,
@@ -45,6 +51,7 @@ from .watchdog import StepWatchdog
 __all__ = [
     "Counter",
     "Gauge",
+    "HbmGauges",
     "Histogram",
     "JsonlSink",
     "MetricsServer",
@@ -57,10 +64,12 @@ __all__ = [
     "StepWatchdog",
     "bucket_percentile",
     "collect_profile",
+    "comms",
     "current_span",
     "get_registry",
     "innermost_active",
     "jaxmon",
+    "memstats",
     "start_metrics_server",
 ]
 

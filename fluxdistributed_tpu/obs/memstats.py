@@ -1,0 +1,463 @@
+"""Memory observability: a static per-program HBM model + live device
+telemetry.
+
+The repo's only memory story so far was *reactive* — OOM-skip catches
+the exception after the allocator already lost — and the pipeline
+planner's per-stage byte estimate (``parallel/pp_plan.py``) was never
+validated against anything.  This module is the missing data layer
+(the memory analog of what ``obs/profile.py`` did for time):
+
+* **static model** — :func:`step_memory` compiles a program (or reuses
+  a caller-held ``Compiled``) and reads XLA's own
+  ``memory_analysis()`` through the :mod:`..compat` shim:
+  argument/output/temp/alias bytes plus the derived ``peak_bytes``
+  (args + outputs + temps − aliased donations — XLA's live-HBM
+  approximation).  :func:`state_bytes` prices a training state
+  EXACTLY from leaf shapes (params / opt state / model state — works
+  on live arrays and eval_shape structs alike), and
+  :func:`variant_report` sweeps every registered variant through the
+  REAL ``prepare_training`` / ``LMEngine`` builders
+  (:mod:`..analysis.variants`) — one compile per program, shared with
+  the collective ledger (:mod:`.comms`) so memory and comms truth come
+  off the same executable.
+* **live telemetry** — :class:`HbmGauges` exposes per-device
+  ``fdtpu_hbm_bytes_{in_use,peak,limit}`` gauges plus
+  ``fdtpu_hbm_headroom_ratio`` (min over devices of
+  ``(limit − in_use)/limit``), all computed AT SCRAPE TIME from
+  ``device.memory_stats()`` so hot paths pay nothing.  On backends
+  that report no memory (CPU) the per-device gauges register no cells,
+  ``fdtpu_hbm_available`` reads 0 and the headroom gauge reads NaN —
+  "unavailable", never a crash and never a fake zero.
+
+Consumers: ``train(observation=)`` (gauges + the watchdog's
+low-headroom alert), the serve scheduler and ``/healthz`` (per-device
+memory block), the N-replica router's ``/metrics`` rollup (the gauges
+ride the replica-labeled re-exposition for free), ``bench.py``'s
+``memory`` stamp, the ``fdtpu-profile/v2`` artifact, and ``bin/fit.py``
+— the "does variant X fit topology Z" checker ROADMAP item 3's
+auto-layout picker will call.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .metrics import Registry, get_registry
+
+__all__ = [
+    "HbmGauges",
+    "check_memory_baseline",
+    "hbm_device_stats",
+    "hbm_summary",
+    "min_headroom_ratio",
+    "pp_plan_memory_check",
+    "state_bytes",
+    "step_memory",
+    "tree_bytes",
+    "variant_report",
+]
+
+#: memory-baseline artifact schema (analysis/memory_baseline.json)
+BASELINE_SCHEMA = "fdtpu-membaseline/v1"
+
+#: default regression tolerance for the baseline ``--check``: a
+#: variant's measured peak may grow this fraction over its committed
+#: baseline before the check fails.  Deliberately loose — XLA's
+#: temp-buffer accounting drifts across jax/jaxlib versions (CI runs a
+#: newer wheel than the pinned image) — while still catching the 2x
+#: regressions that actually break fits.
+DEFAULT_TOLERANCE = 0.5
+
+
+def tree_bytes(tree) -> int:
+    """Exact bytes of every shaped leaf in ``tree`` — live arrays and
+    ``eval_shape`` ShapeDtypeStructs price identically (shape × dtype
+    itemsize; leaves without both are skipped, e.g. None opt slots)."""
+    import jax
+    import jax.numpy as jnp
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        n = 1
+        for d in shape:
+            n *= int(d)
+        total += n * jnp.dtype(dtype).itemsize
+    return int(total)
+
+
+def state_bytes(state) -> Dict[str, int]:
+    """Exact param / optimizer-state / model-state bytes of a training
+    state (``parallel.TrainState`` or anything with those attributes).
+    These are GLOBAL logical bytes — what the arrays hold across the
+    whole mesh; divide by the shard count for per-device footprints
+    (ZeRO-1's whole point is that opt bytes / N is what each device
+    pays)."""
+    params = tree_bytes(getattr(state, "params", None))
+    opt = tree_bytes(getattr(state, "opt_state", None))
+    mstate = tree_bytes(getattr(state, "model_state", None))
+    return {
+        "param_bytes": params,
+        "opt_state_bytes": opt,
+        "model_state_bytes": mstate,
+        "total_bytes": params + opt + mstate,
+    }
+
+
+def step_memory(fn, args: Tuple[Any, ...], compiled=None) -> Optional[dict]:
+    """XLA's compiled-program memory accounting for ``fn`` at ``args``
+    (``{"argument_bytes", "output_bytes", "temp_bytes", "alias_bytes",
+    "generated_code_bytes", "peak_bytes"}``), or None when the program
+    cannot compile here or this jax build reports no
+    ``memory_analysis`` — a missing memory model degrades the artifact,
+    never the run.  Pass ``compiled`` to reuse an executable the caller
+    already paid for (the variant sweep compiles once and feeds both
+    this and the collective ledger)."""
+    from .. import compat
+
+    if compiled is None:
+        try:
+            compiled = fn.lower(*args).compile()
+        except Exception:  # noqa: BLE001 — non-lowerable wrappers → None
+            return None
+    return compat.compiled_memory_analysis(compiled)
+
+
+# -- live device telemetry --------------------------------------------------
+
+def hbm_device_stats() -> Optional[List[dict]]:
+    """Per-local-device memory truth off ``device.memory_stats()``:
+    ``[{"device", "kind", "bytes_in_use", "peak_bytes_in_use",
+    "bytes_limit"}, ...]`` or None when NO local device reports memory
+    (CPU backends return None — the None-safe degradation the gauges
+    and ``/healthz`` lean on)."""
+    import jax
+
+    from .. import compat
+
+    out = []
+    for i, dev in enumerate(jax.local_devices()):
+        st = compat.device_memory_stats(dev)
+        if st is None:
+            continue
+        in_use = int(st.get("bytes_in_use", 0))
+        limit = int(st.get("bytes_limit")
+                    or st.get("bytes_reservable_limit") or 0)
+        out.append({
+            "device": i,
+            "kind": str(getattr(dev, "device_kind", dev.platform)),
+            "bytes_in_use": in_use,
+            "peak_bytes_in_use": int(st.get("peak_bytes_in_use", in_use)),
+            "bytes_limit": limit,
+        })
+    return out or None
+
+
+def hbm_summary() -> dict:
+    """The ``/healthz`` / bench-stamp memory block: per-device stats
+    plus the fleet-facing rollups, or ``{"available": False}`` on
+    backends without memory stats.  Never raises."""
+    try:
+        stats = hbm_device_stats()
+    except Exception:  # noqa: BLE001 — telemetry must not kill a scrape
+        return {"available": False}
+    if not stats:
+        return {"available": False}
+    ratios = [(d["bytes_limit"] - d["bytes_in_use"]) / d["bytes_limit"]
+              for d in stats if d["bytes_limit"] > 0]
+    out = {
+        "available": True,
+        "devices": stats,
+        "bytes_in_use_max": max(d["bytes_in_use"] for d in stats),
+        "peak_bytes_in_use_max": max(
+            d["peak_bytes_in_use"] for d in stats),
+    }
+    if ratios:
+        out["min_headroom_ratio"] = min(ratios)
+    return out
+
+
+def min_headroom_ratio() -> Optional[float]:
+    """Min over devices of ``(limit − in_use)/limit`` — the watchdog's
+    OOM-margin input; None when unavailable (CPU)."""
+    try:
+        stats = hbm_device_stats()
+    except Exception:  # noqa: BLE001
+        return None
+    if not stats:
+        return None
+    ratios = [(d["bytes_limit"] - d["bytes_in_use"]) / d["bytes_limit"]
+              for d in stats if d["bytes_limit"] > 0]
+    return min(ratios) if ratios else None
+
+
+class HbmGauges:
+    """Scrape-time per-device HBM gauges on a registry.
+
+    Registration is get-or-create (safe to build one per
+    train()/Scheduler on a shared registry); availability is probed
+    ONCE at construction — a backend does not grow memory stats
+    mid-process.  When unavailable, only ``fdtpu_hbm_available`` (0)
+    and the NaN headroom gauge expose: the per-device byte gauges
+    register no label cells, so a CPU scrape says "unavailable"
+    instead of inventing zero-byte devices.  ``gauge_names`` lists
+    every name registered here so a retiring scheduler can detach its
+    callbacks (:meth:`close`)."""
+
+    #: one device sweep serves every gauge cell read within this window
+    #: — a /metrics render touches 3 cells per device plus the headroom
+    #: gauge, and each would otherwise re-sweep ALL devices
+    #: (O(devices²) memory_stats calls per scrape, multiplied by the
+    #: router's per-probe replica scrapes)
+    SWEEP_TTL_SECONDS = 0.1
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 name_prefix: str = "fdtpu"):
+        self.registry = registry or get_registry()
+        p = name_prefix
+        self._sweep_at = 0.0
+        self._sweep: Optional[List[dict]] = None
+        try:
+            self.available = hbm_device_stats() is not None
+        except Exception:  # noqa: BLE001 — a broken backend reads as absent
+            self.available = False
+        g = self.registry.gauge
+        self._avail = g(
+            f"{p}_hbm_available",
+            "1 when device.memory_stats() reports HBM truth, 0 on "
+            "backends without it (CPU)")
+        self._avail.set(1.0 if self.available else 0.0)
+        self._headroom = g(
+            f"{p}_hbm_headroom_ratio",
+            "min over devices of (bytes_limit - bytes_in_use) / "
+            "bytes_limit — the OOM margin; NaN when unavailable")
+        def _headroom_or_nan() -> float:
+            stats = self._sweep_stats()
+            if not stats:
+                return math.nan
+            ratios = [(d["bytes_limit"] - d["bytes_in_use"])
+                      / d["bytes_limit"]
+                      for d in stats if d["bytes_limit"] > 0]
+            return min(ratios) if ratios else math.nan
+
+        self._headroom.set_function(_headroom_or_nan)
+        self.gauge_names = [f"{p}_hbm_available",
+                            f"{p}_hbm_headroom_ratio"]
+        self._per_device = []
+        if self.available:
+            for name, key, txt in (
+                (f"{p}_hbm_bytes_in_use", "bytes_in_use",
+                 "HBM bytes currently allocated, per device"),
+                (f"{p}_hbm_bytes_peak", "peak_bytes_in_use",
+                 "peak HBM bytes allocated since process start, "
+                 "per device"),
+                (f"{p}_hbm_bytes_limit", "bytes_limit",
+                 "HBM capacity the allocator may use, per device"),
+            ):
+                gauge = g(name, txt, labelnames=("device",))
+                self.gauge_names.append(name)
+                self._per_device.append((gauge, key))
+            import jax
+
+            for i in range(len(jax.local_devices())):
+                for gauge, key in self._per_device:
+                    gauge.labels(device=str(i)).set_function(
+                        lambda i=i, key=key: self._read(i, key))
+
+    def _sweep_stats(self) -> Optional[List[dict]]:
+        """One :func:`hbm_device_stats` sweep per ``SWEEP_TTL_SECONDS``
+        window, shared by every gauge cell a scrape renders."""
+        import time
+
+        now = time.monotonic()
+        if now - self._sweep_at > self.SWEEP_TTL_SECONDS:
+            try:
+                self._sweep = hbm_device_stats()
+            except Exception:  # noqa: BLE001 — a broken read scrapes NaN
+                self._sweep = None
+            self._sweep_at = now
+        return self._sweep
+
+    def _read(self, device: int, key: str) -> float:
+        stats = self._sweep_stats()
+        if not stats:
+            return math.nan
+        for d in stats:
+            if d["device"] == device:
+                return float(d[key])
+        return math.nan
+
+    def summary(self) -> dict:
+        """The dict block ``/healthz`` and the bench stamp embed."""
+        return hbm_summary()
+
+    def close(self) -> None:
+        """Detach the scrape-time callbacks from a SHARED registry
+        (mirrors ``Scheduler.close()`` — retired callback closures must
+        not pin dead engines or keep scraping stale backends)."""
+        for name in self.gauge_names:
+            self.registry.unregister(name)
+
+
+# -- the per-variant sweep (shared with the collective ledger) --------------
+
+def variant_report(names: Optional[Sequence[str]] = None,
+                   include_hlo: bool = True) -> Dict[str, dict]:
+    """Memory + collective truth for every registered variant, built
+    through the REAL ``prepare_training`` / ``LMEngine`` paths
+    (:mod:`..analysis.variants`) and compiled ONCE each — the
+    executable feeds XLA's ``memory_analysis`` AND the post-
+    optimization HLO collective ledger, so both describe the same
+    program.  Per entry::
+
+        {"source": ...,              # repo file the program came from
+         "args_bytes": N,            # exact input bytes (leaf shapes)
+         "memory": {...} | None,     # step_memory; None = unavailable
+         "comms": {"jaxpr": [...],   # explicit collectives (shard_map)
+                   "hlo": [...]},    # compiled collectives (GSPMD too)
+         "unavailable": "reason"}    # only when the compile failed
+
+    Expensive (compiles each variant on the live mesh) — an offline
+    artifact/CI path, not a hot one."""
+    from ..analysis.variants import build_variants
+    from .comms import hlo_collectives, jaxpr_collectives
+
+    out: Dict[str, dict] = {}
+    for v in build_variants(names):
+        entry: dict = {"source": v.source,
+                       "args_bytes": tree_bytes(v.args)}
+        comms: dict = {}
+        try:
+            comms["jaxpr"] = jaxpr_collectives(v.fn, v.args)
+        except Exception as e:  # noqa: BLE001 — ledger is best-effort
+            comms["jaxpr_unavailable"] = f"{type(e).__name__}: {e}"[:200]
+        compiled = None
+        try:
+            compiled = v.fn.lower(*v.args).compile()
+        except Exception as e:  # noqa: BLE001 — a variant that cannot
+            # compile here still reports its jaxpr-level ledger
+            entry["unavailable"] = f"{type(e).__name__}: {e}"[:200]
+        if compiled is not None:
+            entry["memory"] = step_memory(v.fn, v.args, compiled=compiled)
+            if include_hlo:
+                try:
+                    comms["hlo"] = hlo_collectives(compiled, mesh=v.mesh)
+                except Exception as e:  # noqa: BLE001
+                    comms["hlo_unavailable"] = (
+                        f"{type(e).__name__}: {e}"[:200])
+        entry["comms"] = comms
+        out[v.name] = entry
+    return out
+
+
+# -- baseline workflow (the lint-baseline idiom for memory) -----------------
+
+def check_memory_baseline(current: Dict[str, dict], baseline: dict,
+                          tolerance: Optional[float] = None) -> dict:
+    """Compare a :func:`variant_report` sweep against the committed
+    baseline (``analysis/memory_baseline.json``).  The lint-baseline
+    contract: FAIL only on NEW regressions — a variant whose measured
+    ``peak_bytes`` grew beyond ``(1 + tolerance) ×`` its committed
+    value, or a variant the baseline does not cover at all (CI must
+    force the baseline to stay exhaustive).  Shrinkage and stale
+    baseline entries are reported non-fatally.  Returns ``{"failures":
+    [...], "notes": [...], "checked": N, "tolerance": t}``."""
+    doc = baseline.get("variants", {})
+    tol = (tolerance if tolerance is not None
+           else float(baseline.get("tolerance", DEFAULT_TOLERANCE)))
+    failures, notes = [], []
+    checked = 0
+    for name, entry in sorted(current.items()):
+        mem = entry.get("memory")
+        if not mem:
+            notes.append(f"{name}: memory_analysis unavailable here — "
+                         "not checked")
+            continue
+        base = doc.get(name)
+        if base is None:
+            failures.append(
+                f"{name}: not covered by the baseline — run "
+                "bin/fit.py --update-baseline so every registered "
+                "variant stays a CI-gated invariant")
+            continue
+        checked += 1
+        peak = int(mem["peak_bytes"])
+        ref = int(base.get("peak_bytes", 0))
+        if ref and peak > ref * (1.0 + tol):
+            failures.append(
+                f"{name}: peak_bytes {peak} regressed beyond "
+                f"{ref} x (1 + {tol}) — a real memory regression, or "
+                "an intentional change needing --update-baseline")
+        elif ref and peak < ref / (1.0 + tol):
+            notes.append(f"{name}: peak_bytes {peak} shrank well below "
+                         f"baseline {ref} — consider re-recording")
+    for name in sorted(set(doc) - set(current)):
+        notes.append(f"stale baseline entry {name!r} — variant no "
+                     "longer registered; shrink the baseline")
+    return {"failures": failures, "notes": notes, "checked": checked,
+            "tolerance": tol}
+
+
+def build_baseline(current: Dict[str, dict],
+                   tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """The committed-baseline document for a sweep (drops entries whose
+    memory model was unavailable — they cannot regress)."""
+    variants = {}
+    for name, entry in sorted(current.items()):
+        mem = entry.get("memory")
+        if mem:
+            variants[name] = {
+                k: int(mem[k]) for k in (
+                    "peak_bytes", "argument_bytes", "output_bytes",
+                    "temp_bytes", "alias_bytes")}
+    return {"schema": BASELINE_SCHEMA, "tolerance": tolerance,
+            "variants": variants}
+
+
+# -- pp_plan cross-validation ----------------------------------------------
+
+#: documented tolerance band for :func:`pp_plan_memory_check`.  The
+#: plan's ``stage_bytes`` model the per-stage WORKING SET the schedule
+#: holds live (stage params + the min(S, M)-slot activation input
+#: ring).  The compiled step's ``peak_bytes`` additionally carries what
+#: the model deliberately leaves out — gradients, optimizer moments,
+#: XLA temps and the batch itself — so the honest invariant is a band,
+#: not equality: the measured peak must be at least the modeled peak
+#: stage (the estimate is a lower bound by construction) and at most
+#: ``PP_MEMORY_FACTOR ×`` the modeled TOTAL (params + grads + two Adam
+#: moments + activations + temps ≈ 5-6× params; 8 leaves margin for
+#: XLA's layout padding without letting an order-of-magnitude modeling
+#: bug through).
+PP_MEMORY_FACTOR = 8.0
+
+
+def pp_plan_memory_check(plan, fn, args: Tuple[Any, ...],
+                         factor: float = PP_MEMORY_FACTOR) -> dict:
+    """Cross-validate a :class:`~..parallel.pp_plan.PipelinePlan`'s
+    per-stage memory estimate against XLA's ``memory_analysis`` of the
+    REAL compiled step it drives (see :data:`PP_MEMORY_FACTOR` for the
+    documented band).  Returns a report dict with ``within`` — False
+    when the estimate and the compiler disagree beyond the band, or
+    when the plan recorded no estimate; ``measured`` is None (and
+    ``within`` None, "unavailable") on builds without a memory model."""
+    measured = step_memory(fn, args)
+    modeled = [float(b) for b in getattr(plan, "stage_bytes", ()) or ()]
+    report: dict = {
+        "modeled_stage_bytes": modeled,
+        "modeled_peak_stage": max(modeled) if modeled else 0.0,
+        "modeled_total": sum(modeled),
+        "factor": factor,
+        "measured": measured,
+        "within": None,
+    }
+    if measured is None or not modeled:
+        return report
+    peak = float(measured["peak_bytes"])
+    report["within"] = (
+        report["modeled_peak_stage"] <= peak
+        <= factor * report["modeled_total"])
+    return report

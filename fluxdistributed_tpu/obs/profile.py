@@ -29,9 +29,10 @@ topology block, and rejects cross-topology reuse at load time
 measured on 8 CPU devices must never silently drive stage placement on
 a v5e slice.
 
-Schema (``fdtpu-profile/v1``)::
+Schema (``fdtpu-profile/v2`` — v1 artifacts still load; the additive
+``memory`` and ``comms`` sections simply read empty)::
 
-    {"schema": "fdtpu-profile/v1", "created_unix": ...,
+    {"schema": "fdtpu-profile/v2", "created_unix": ...,
      "fingerprint": "<16-hex topology digest>",
      "topology": {"jax", "platform", "device_kind",
                   "device_count", "process_count", "mesh"},
@@ -41,16 +42,27 @@ Schema (``fdtpu-profile/v1``)::
                           "total": {"flops", "bytes"}} | null,
                 "step":  {"flops", "bytes"} | null,
                 "variants": {name: {"flops", "bytes"}}},
+     "memory": {"state": {"param_bytes", "opt_state_bytes",
+                          "model_state_bytes", "total_bytes"},
+                "step": {"argument_bytes", "output_bytes",
+                         "temp_bytes", "alias_bytes",
+                         "generated_code_bytes",
+                         "peak_bytes"} | null,   # memory_analysis
+                "variants": {name: {...}}},      # bin/fit.py sweeps
+     "comms": {"step": {"jaxpr": [...], "hlo": [...]},  # obs.comms
+               "variants": {name: {...}}},
      "measured": {"phases": {phase: {"sum", "count",
                                      "bounds", "counts"}},
                   "step_seconds": {...}, "counters": {...},
+                  "hbm": {...},               # live memory_stats peak
                   "pp_rows": [...]},          # pp_bubble.py runs only
      "meta": {...}}
 
 Consumers today: ``benchmarks/pp_bubble.py`` (modeled-vs-measured
 bubble accounting via :func:`bubble_report`), ``bin/driver.py
---profile-out`` (trainer runs), and — next — the profile-guided stage
-partitioner (docs/parallelism.md).
+--profile-out`` (trainer runs), the profile-guided stage partitioner
+(docs/parallelism.md), and ``bin/fit.py`` — the memory/comms fit
+checker that ranks variants by HBM headroom on a topology.
 """
 
 from __future__ import annotations
@@ -75,7 +87,11 @@ __all__ = [
     "variant_costs",
 ]
 
-SCHEMA = "fdtpu-profile/v1"
+SCHEMA = "fdtpu-profile/v2"
+#: schemas ``Profile.load`` accepts: v1 artifacts predate the memory /
+#: comms sections (purely additive — every v1 key means the same thing
+#: in v2), so planners and replay tools keep reading them
+ACCEPTED_SCHEMAS = ("fdtpu-profile/v1", SCHEMA)
 
 
 class ProfileMismatch(ValueError):
@@ -219,6 +235,12 @@ class Profile:
     fingerprint: str
     topology: dict = dataclasses.field(default_factory=dict)
     static: dict = dataclasses.field(default_factory=dict)
+    #: static memory model (state/step/variants — obs.memstats); empty
+    #: on v1 artifacts
+    memory: dict = dataclasses.field(default_factory=dict)
+    #: collective-traffic ledger (step/variants — obs.comms); empty on
+    #: v1 artifacts
+    comms: dict = dataclasses.field(default_factory=dict)
     measured: dict = dataclasses.field(default_factory=dict)
     meta: dict = dataclasses.field(default_factory=dict)
     schema: str = SCHEMA
@@ -241,12 +263,17 @@ class Profile:
         with open(path) as f:
             doc = json.load(f)
         schema = doc.get("schema")
-        if schema != SCHEMA:
+        if schema not in ACCEPTED_SCHEMAS:
             raise ValueError(
-                f"{path}: not a {SCHEMA} artifact (schema={schema!r}) — "
-                "regenerate it with this repo's profiler")
+                f"{path}: not a {'/'.join(ACCEPTED_SCHEMAS)} artifact "
+                f"(schema={schema!r}) — regenerate it with this repo's "
+                "profiler")
         fields = {f.name for f in dataclasses.fields(cls)}
-        return cls(**{k: v for k, v in doc.items() if k in fields})
+        prof = cls(**{k: v for k, v in doc.items() if k in fields})
+        # a loaded artifact keeps its recorded schema tag (a v1 doc
+        # re-saved without re-collection must not masquerade as v2)
+        prof.schema = schema
+        return prof
 
     # -- topology gate -------------------------------------------------
     def verify(self, mesh=None, tag: str = "") -> "Profile":
@@ -266,6 +293,22 @@ class Profile:
                 "cost profiles do not transfer across topologies; "
                 "re-collect on this one")
         return self
+
+
+def _step_compile_is_cheap() -> bool:
+    """Whether re-compiling the step for ``memory_analysis`` is
+    acceptable at artifact-collection time: always on CPU; on an
+    accelerator only when jax's persistent compilation cache is
+    configured (the recompile then hits — or seeds — the cache instead
+    of burning minutes)."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return True
+    try:
+        return bool(jax.config.jax_compilation_cache_dir)
+    except AttributeError:  # knob-less build: no cache to absorb it
+        return False
 
 
 def collect_profile(task=None, registry: Optional[Registry] = None,
@@ -300,6 +343,61 @@ def collect_profile(task=None, registry: Optional[Registry] = None,
     if task is not None and batch is not None:
         static["step"] = step_cost(task.step_fn, (task.state, batch))
     prof.static = static
+    # -- v2 sections: the memory model and the collective ledger of the
+    # REAL step this run compiled, plus the live HBM peak.  All
+    # best-effort: every piece degrades to null/empty independently
+    # (knob-less jax builds, non-lowerable wrappers, CPU memory_stats)
+    from . import comms as comms_lib
+    from . import memstats
+
+    memory: dict = {"state": None, "step": None, "variants": {}}
+    comms: dict = {"step": {}, "variants": {}}
+    if task is not None:
+        try:
+            memory["state"] = memstats.state_bytes(task.state)
+        except Exception:  # noqa: BLE001 — exotic state trees degrade
+            pass
+    if task is not None and batch is not None:
+        args = (task.state, batch)
+        try:
+            comms["step"]["jaxpr"] = comms_lib.jaxpr_collectives(
+                task.step_fn, args)
+        except Exception:  # noqa: BLE001 — non-traceable wrappers
+            pass
+        # memory_analysis / post-opt HLO need a COMPILED program, and
+        # lower().compile() here cannot reuse the executable the jit
+        # call already built — it is a real second XLA compile.  On CPU
+        # that is cheap; on an accelerator it is only acceptable when
+        # the persistent compilation cache will absorb it (and populate
+        # itself for the next run).  Without the cache, skip: a
+        # finished TPU run must not pay minutes of recompile for an
+        # optional artifact section.
+        compiled = None
+        if _step_compile_is_cheap():
+            try:
+                compiled = task.step_fn.lower(*args).compile()
+            except Exception:  # noqa: BLE001 — AOT/strict-check wrappers
+                compiled = None
+        else:
+            memory["step_note"] = (
+                "step memory_analysis skipped: recompiling on this "
+                "backend without a persistent compilation cache costs "
+                "a full XLA compile — enable "
+                "compilation.enable_persistent_cache (driver "
+                "--compile-cache) to collect it")
+        if compiled is not None:
+            memory["step"] = memstats.step_memory(
+                task.step_fn, args, compiled=compiled)
+            try:
+                comms["step"]["hlo"] = comms_lib.hlo_collectives(
+                    compiled, mesh=mesh)
+            except Exception:  # noqa: BLE001
+                pass
+    prof.memory = memory
+    prof.comms = comms
+    hbm = memstats.hbm_summary()
+    if hbm.get("available"):
+        prof.measured["hbm"] = hbm
     return prof
 
 

@@ -24,7 +24,13 @@ elastically resumes on.
 The existing OOM-skip counter folds in through :meth:`note_skip`: a
 skipped batch both keeps the heartbeat alive (the loop IS making
 progress) and increments ``fdtpu_train_oom_skipped_total`` — one place
-to watch for "training is quietly throwing work away".
+to watch for "training is quietly throwing work away".  Its PROACTIVE
+sibling is :meth:`note_headroom`: the loop reports the minimum HBM
+headroom ratio (``obs.memstats.min_headroom_ratio``) and the watchdog
+keeps the ``fdtpu_hbm_headroom_ratio`` gauge current and fires ONE
+low-headroom warning per episode when it drops under ``headroom_warn``
+— the OOM-margin alarm that rings BEFORE the allocator loses, next to
+the counter that tallies the batches lost after.
 
 The check logic lives in :meth:`poll` so tests drive it synchronously;
 the thread is just ``poll`` on a timer.
@@ -72,6 +78,10 @@ class StepWatchdog:
     on_escalate: ``fn(elapsed_sec, threshold_sec)`` abort callback run
         at escalation — e.g. dump state and ``os._exit``; default is a
         stderr warning (the counter alone is the remote signal)
+    headroom_warn: minimum HBM headroom ratio below which
+        :meth:`note_headroom` fires its once-per-episode warning (an
+        episode ends when headroom recovers above the threshold);
+        0 disables the alert while the gauge stays live
     registry: metrics registry (default: the process registry)
     """
 
@@ -85,6 +95,7 @@ class StepWatchdog:
         on_stall: Optional[Callable[[float, float], None]] = None,
         escalate_after: int = 0,
         on_escalate: Optional[Callable[[float, float], None]] = None,
+        headroom_warn: float = 0.05,
         registry: Optional[Registry] = None,
         name_prefix: str = "fdtpu",
     ):
@@ -93,6 +104,9 @@ class StepWatchdog:
         if escalate_after < 0:
             raise ValueError(
                 f"escalate_after must be >= 0, got {escalate_after}")
+        if not 0.0 <= headroom_warn < 1.0:
+            raise ValueError(
+                f"headroom_warn must be in [0, 1), got {headroom_warn}")
         self.factor = factor
         self.min_interval = min_interval
         self.check_every = check_every
@@ -131,7 +145,25 @@ class StepWatchdog:
             "stalls that persisted past escalate_after further threshold "
             "windows (the wedged-collective signal supervisors kill on)",
         )
+        # the OOM-margin pair: the gauge is the live margin, the
+        # counter tallies low-headroom EPISODES (warn-once semantics,
+        # mirroring the stall counter)
+        self.headroom_warn = headroom_warn
+        self._headroom_low = False
+        self._headroom = self.registry.gauge(
+            f"{name_prefix}_hbm_headroom_ratio",
+            "min over devices of (bytes_limit - bytes_in_use) / "
+            "bytes_limit — the OOM margin; NaN when unavailable",
+        )
+        self._low_headroom_total = self.registry.counter(
+            f"{name_prefix}_watchdog_low_headroom_total",
+            "episodes where HBM headroom dropped below headroom_warn "
+            "(the proactive sibling of the OOM-skip counter)",
+        )
         self._stalled.set(0)
+        # NaN until the loop reports a real margin: 0.0 would read as
+        # "about to OOM" on backends that simply have no memory stats
+        self._headroom.set(float("nan"))
         #: innermost active span/phase at the most recent stall fire
         #: (None when nothing was bracketed) — set BEFORE on_stall runs
         self.last_where: Optional[str] = None
@@ -156,6 +188,39 @@ class StepWatchdog:
         of work (the reference's dead ``num_missed``, now scrapeable)."""
         self._skips.inc(n)
         self.beat()
+
+    def note_headroom(self, ratio: Optional[float]) -> bool:
+        """Report the current minimum HBM headroom ratio (the trainer
+        samples ``obs.memstats.min_headroom_ratio()`` per step).  Keeps
+        the ``fdtpu_hbm_headroom_ratio`` gauge current and fires ONE
+        warning + counter tick per low-headroom EPISODE — an episode
+        opens when the ratio drops under ``headroom_warn`` and closes
+        when it recovers, so a run hovering at 3% margin pages once,
+        not once per step.  ``None`` (no memory stats on this backend)
+        is a no-op: the gauge stays NaN, never a fake alarm.  Returns
+        True iff a new episode fired."""
+        if ratio is None:
+            return False
+        ratio = float(ratio)
+        self._headroom.set(ratio)
+        if not self.headroom_warn:
+            return False
+        if ratio >= self.headroom_warn:
+            self._headroom_low = False
+            return False
+        if self._headroom_low:
+            return False
+        self._headroom_low = True
+        self._low_headroom_total.inc()
+        print(
+            f"obs.watchdog: LOW HBM HEADROOM — min device margin "
+            f"{ratio:.1%} (< {self.headroom_warn:.1%}); the next "
+            "allocation spike (longer batch, eval, checkpoint "
+            "snapshot) may OOM — shrink the batch or re-plan the "
+            "layout (bin/fit.py)",
+            file=sys.stderr,
+        )
+        return True
 
     @contextlib.contextmanager
     def pause(self):

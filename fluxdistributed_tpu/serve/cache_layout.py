@@ -34,7 +34,7 @@ from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["DenseLayout", "PagedLayout", "BlockPool", "prefix_digests",
-           "KV_STORE_BYTES", "kv_row_bytes"]
+           "KV_STORE_BYTES", "kv_row_bytes", "reserved_kv_bytes"]
 
 
 def prefix_digests(tokens: Sequence[int], block_size: int) -> List[bytes]:
@@ -79,6 +79,22 @@ def kv_row_bytes(hkv: int, head_dim: int, kv_quant: str,
     return 2 * per  # K and V
 
 
+def reserved_kv_bytes(layout, depth: int, hkv: int, head_dim: int,
+                      compute_itemsize: int) -> int:
+    """Total HBM bytes a layout's KV storage reserves across ``depth``
+    layers — THE sizing model.  ``layout.reserved_rows()`` supplies the
+    per-layer row count each layout actually allocates (dense: every
+    slot's rows; paged: the whole block pool, shared), and
+    :func:`kv_row_bytes` prices one row including the quantization
+    scale leaves.  The engine's MEASURED ``kv_cache_bytes()`` is
+    cross-checked against this figure (its ``predicted`` key; parity
+    pinned by test in both layouts for every kv_quant scenario) so the
+    accounting the fit checker and the benches report can never drift
+    from the math admission control sizes pools with."""
+    return depth * layout.reserved_rows() * kv_row_bytes(
+        hkv, head_dim, layout.kv_quant, compute_itemsize)
+
+
 class DenseLayout:
     """The original fixed-slot layout: each slot statically owns
     ``rows_per_slot`` contiguous KV rows per layer.  Admission never
@@ -98,6 +114,11 @@ class DenseLayout:
 
     def can_admit(self, prompt: Sequence[int], max_new_tokens: int) -> bool:
         return True
+
+    def reserved_rows(self) -> int:
+        """KV rows allocated per layer: every slot statically owns its
+        full span for the engine's lifetime."""
+        return self.max_slots * self.rows_per_slot
 
     def stats(self) -> dict:
         return {"kv_quant": self.kv_quant}
@@ -281,6 +302,11 @@ class PagedLayout:
         """Blocks needed to hold ``ntokens`` positions: row reuse caps
         the answer at ``pages_per_slot`` for windowed rings."""
         return -(-min(ntokens, self.r_pad) // self.block_size)
+
+    def reserved_rows(self) -> int:
+        """KV rows allocated per layer: the whole shared block pool
+        (slots bind pool blocks; nothing is reserved per slot)."""
+        return self.pool.num_blocks * self.block_size
 
     # ---- admission --------------------------------------------------------
 

@@ -883,9 +883,16 @@ class LMEngine:
 
     def kv_cache_bytes(self) -> dict:
         """KV HBM accounting: ``reserved`` is what the cache tensors
-        occupy; ``live`` is the fraction actually backing live tokens
-        (== reserved for dense — the whole point of the paged layout is
-        the gap between the two)."""
+        occupy (measured off the live leaves); ``live`` is the fraction
+        actually backing live tokens (== reserved for dense — the whole
+        point of the paged layout is the gap between the two);
+        ``predicted`` is the layout's own sizing model
+        (:func:`..serve.cache_layout.reserved_kv_bytes` — the ONE
+        source of truth admission control and the benches share),
+        parity-pinned against ``reserved`` by test in BOTH layouts for
+        every kv_quant scenario including the int8/fp8 scale leaves."""
+        from .cache_layout import reserved_kv_bytes
+
         total = 0
         for path, leaf in jax.tree_util.tree_flatten_with_path(self.cache)[0]:
             # K/V rows plus their quantization scales (the scales are
@@ -894,11 +901,18 @@ class LMEngine:
             if _leaf_name(path) in ("cached_k", "cached_v",
                                     "cached_k_scale", "cached_v_scale"):
                 total += leaf.size * leaf.dtype.itemsize
-        if self.layout_name != "paged":
-            return {"reserved": total, "live": total}
-        s = self.layout.stats()
-        frac = s["kv_blocks_active"] / max(1, s["kv_blocks_total"])
-        return {"reserved": total, "live": int(total * frac)}
+        model = self.model
+        predicted = reserved_kv_bytes(
+            self.layout, int(model.depth),
+            int(model.num_kv_heads or model.num_heads),
+            int(model.dim // model.num_heads),
+            jnp.dtype(model.dtype).itemsize)
+        out = {"reserved": total, "live": total, "predicted": predicted}
+        if self.layout_name == "paged":
+            s = self.layout.stats()
+            frac = s["kv_blocks_active"] / max(1, s["kv_blocks_total"])
+            out["live"] = int(total * frac)
+        return out
 
     def compile_stats(self) -> dict:
         """Compile counts per program — the no-recompile steady-state

@@ -232,6 +232,25 @@ class Scheduler:
                 g(p + f"{stem}_p{q}",
                   f"p{q} of {hist.name} (bucket-estimated)").set_function(
                     lambda hist=hist, q=q: hist.percentile(q))
+        # KV-cache HBM truth next to the block-pool gauges: reserved =
+        # what the cache tensors occupy, live = the fraction backing
+        # live tokens (dense: equal; paged: the gap IS the layout win)
+        self._kv_bytes_at = 0.0
+        self._kv_bytes_memo: dict = {}
+        for key, txt in (
+            ("kv_cache_reserved_bytes",
+             "HBM bytes the KV cache tensors occupy"),
+            ("kv_cache_live_bytes",
+             "KV cache bytes backing LIVE tokens"),
+        ):
+            g(p + key, txt).set_function(
+                lambda key=key: float(self._kv_bytes(key)))
+        # per-device HBM gauges (fdtpu_hbm_bytes_* / headroom at scrape
+        # time; availability flag + NaN headroom on CPU) — the router's
+        # /metrics rollup re-exposes them replica-labeled for free
+        from ..obs.memstats import HbmGauges
+
+        self.hbm = HbmGauges(self.registry)
         self._callback_gauges = [
             p + k for k in (
                 "queue_depth", "active_slots", "max_slots",
@@ -239,15 +258,29 @@ class Scheduler:
                 "ttft_sec_avg", "decode_compiles", "prefill_compiles",
                 "insert_compiles", "kv_blocks_total", "kv_blocks_free",
                 "kv_blocks_active", "kv_blocks_cached",
+                "kv_cache_reserved_bytes", "kv_cache_live_bytes",
                 "queue_wait_sec_p50", "queue_wait_sec_p95",
                 "tbt_sec_p50", "tbt_sec_p95",
                 "ttft_hist_sec_p50", "ttft_hist_sec_p95",
             )
-        ]
+        ] + list(self.hbm.gauge_names)
 
     def _pool_stat(self, key: str) -> float:
         ps = getattr(self.engine, "pool_stats", None)
         return (ps() if callable(ps) else {}).get(key, 0)
+
+    def _kv_bytes(self, key: str) -> float:
+        # one kv_cache_bytes() tree walk serves BOTH gauges of a scrape
+        # (each /metrics render reads reserved then live back-to-back)
+        kb = getattr(self.engine, "kv_cache_bytes", None)
+        if not callable(kb):
+            return 0.0
+        now = time.monotonic()
+        if now - self._kv_bytes_at > 0.1:
+            self._kv_bytes_memo = kb()
+            self._kv_bytes_at = now
+        return float(self._kv_bytes_memo.get(
+            "reserved" if key.endswith("reserved_bytes") else "live", 0))
 
     def _sync_prefix_counters(self) -> None:
         """Fold the engine's cumulative prefix-cache tallies into the

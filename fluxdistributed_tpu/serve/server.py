@@ -70,6 +70,23 @@ class LMServer:
             "engine-loop exceptions survived (nonzero = check logs)",
         ).set_function(lambda: self.loop_errors)
 
+    def _memory_block(self) -> dict:
+        """The /healthz memory payload: per-device HBM stats plus the
+        KV cache's reserved/live bytes; ``{"available": false}`` (with
+        the KV figures when the engine reports them) on backends
+        without memory stats.  Never raises — a broken telemetry read
+        must not take down the health endpoint."""
+        try:
+            from ..obs.memstats import hbm_summary
+
+            out = hbm_summary()
+            kb = getattr(self.scheduler.engine, "kv_cache_bytes", None)
+            if callable(kb):
+                out["kv_cache"] = kb()
+            return out
+        except Exception:  # noqa: BLE001
+            return {"available": False}
+
     # ---- engine loop ------------------------------------------------------
 
     def start_loop(self) -> None:
@@ -262,6 +279,11 @@ class LMServer:
                         "max_slots": sched.engine.max_slots,
                         "queue_depth": sched.queue_depth,
                         "loop_errors": outer.loop_errors,
+                        # per-device HBM truth (obs.memstats), or
+                        # {"available": false} on CPU — a router can
+                        # see a replica running out of margin before
+                        # it starts OOMing requests
+                        "memory": outer._memory_block(),
                     }
                     if outer.bound_port is not None:
                         body["port"] = outer.bound_port

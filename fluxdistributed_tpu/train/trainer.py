@@ -1119,13 +1119,24 @@ class _PhaseClock:
     observes its wall seconds into the registry's per-phase histogram
     and, when a tracer rides along, opens a span with the same name —
     ONE set of brackets feeds both the live ``/metrics`` percentiles
-    and the offline Chrome/Perfetto timeline."""
+    and the offline Chrome/Perfetto timeline.  When the backend
+    reports HBM truth and a watchdog rides along, every phase exit
+    also samples ``device.memory_stats()`` into the watchdog's
+    OOM-margin gauge/alert (:meth:`~..obs.watchdog.StepWatchdog
+    .note_headroom`) — per-PHASE sampling, because the margin is
+    tightest inside eval/checkpoint phases a per-step sample would
+    straddle."""
 
-    def __init__(self, observation: Observation):
+    def __init__(self, observation: Observation, hbm=None):
         from ..obs.spans import phase_scope
 
         self.tracer = observation.tracer
         self._phase_scope = phase_scope
+        # headroom sampling only when BOTH truths exist: live memory
+        # stats (hbm.available — CPU short-circuits to zero cost) and
+        # a watchdog to route the alert through
+        self.watchdog = (observation.watchdog
+                         if hbm is not None and hbm.available else None)
         self.hist = observation.registry.histogram(
             "fdtpu_train_phase_seconds",
             "wall seconds per train-step phase "
@@ -1151,6 +1162,10 @@ class _PhaseClock:
             # OOM-heavy run must not show artificially fast dispatch
             # percentiles while its trace shows the slow truth
             self.hist.labels(phase=name).observe(time.perf_counter() - t0)
+            if self.watchdog is not None:
+                from ..obs import memstats
+
+                self.watchdog.note_headroom(memstats.min_headroom_ratio())
 
 
 def train(
@@ -1242,9 +1257,14 @@ def train(
     from ..parallel import multihost
     logger = logger or current_logger()
     obs = observation or Observation.default()
-    phases = _PhaseClock(obs)
     reg = obs.registry
     jaxmon.install(reg)  # compile counters (idempotent, process-global)
+    # per-device HBM gauges (fdtpu_hbm_bytes_* at scrape time; the
+    # availability flag + NaN headroom on CPU — "unavailable", never 0)
+    from ..obs import memstats as memstats_lib
+
+    hbm = memstats_lib.HbmGauges(reg)
+    phases = _PhaseClock(obs, hbm=hbm)
     steps_total = reg.counter(
         "fdtpu_train_steps_total", "optimizer steps completed")
     step_hist = reg.histogram(

@@ -115,6 +115,10 @@ def test_main_emits_error_json_and_rc0_on_failure(bench_mod, monkeypatch, capsys
     # records the fault/watchdog/guard counters it saw (or that it saw
     # none — the stamp is never absent)
     assert isinstance(out["guard"], dict)
+    # the memory stamp rides the error JSON too: a dead round records
+    # the HBM state at death ({"available": false} here — CPU has no
+    # memory_stats, the None-safe degradation, never a crash)
+    assert out["memory"] == {"available": False}
 
     class FakeDone:
         returncode = 1
@@ -147,7 +151,27 @@ def test_status_file_snapshots_phase_and_compile_ledger(bench_mod, tmp_path):
     assert snap["phase"] == "compile"
     for key in ("compile_seconds", "cache_hits", "cache_misses"):
         assert key in snap
+    # the memory stamp relays through the child status file like the
+    # guard stamp — dead hw rounds record memory state at death
+    assert snap["memory"] == {"available": False}
     bench_mod._write_status(None, "ignored")  # disabled path: no raise
+
+
+def test_memory_stamp_static_bytes(bench_mod):
+    """memory_stamp(state): live HBM summary (unavailable on CPU) plus
+    the exact static bytes of the bench state when it is at hand."""
+    import jax.numpy as jnp
+
+    class S:
+        params = {"w": jnp.zeros((4, 4), jnp.float32)}
+        opt_state = {"m": jnp.zeros((4, 4), jnp.float32)}
+        model_state = {}
+
+    out = bench_mod.memory_stamp(S())
+    assert out["available"] is False
+    assert out["static"]["param_bytes"] == 64
+    assert out["static"]["total_bytes"] == 128
+    assert "static" not in bench_mod.memory_stamp()
 
 
 def _tiny_build_step(batch, **kw):

@@ -1,0 +1,169 @@
+"""bin/fit.py — the memory/comms fit checker: headroom ranking,
+oversized-config rejection, the baseline --check workflow, and the
+topology gate, all driven through main(argv) in-process.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fit():
+    spec = importlib.util.spec_from_file_location(
+        "fdtpu_fit_cli", os.path.join(REPO, "bin", "fit.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def fit():
+    return _fit()
+
+
+@pytest.fixture()
+def artifact(tmp_path):
+    """A v2 artifact with one small and one provably-oversized
+    variant, fingerprinted for THIS process so the topology gate
+    passes."""
+    from fluxdistributed_tpu.compilation import topology_fingerprint
+    from fluxdistributed_tpu.obs.profile import Profile, describe_topology
+
+    def mem(peak):
+        return {"memory": {"peak_bytes": peak, "argument_bytes": peak,
+                           "output_bytes": 0, "temp_bytes": 0,
+                           "alias_bytes": 0,
+                           "generated_code_bytes": 0}}
+
+    prof = Profile(
+        fingerprint=topology_fingerprint(),
+        topology=describe_topology(),
+        memory={"state": None, "step": None,
+                "variants": {"small": mem(1_000),
+                             "huge": mem(10**15),
+                             "dark": {"memory": None}}},
+        comms={"step": {}, "variants": {}},
+    )
+    path = tmp_path / "fit.profile.json"
+    prof.save(str(path))
+    return str(path)
+
+
+def test_ranking_and_fit_verdicts(fit, artifact, capsys):
+    rc = fit.main(["--profile", artifact, "--hbm-bytes", "1e6",
+                   "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    rows = {r["variant"]: r for r in out["rows"]}
+    assert rows["small"]["fits"] is True
+    assert rows["small"]["headroom_bytes"] == 1_000_000 - 1_000
+    assert rows["huge"]["fits"] is False
+    assert rows["dark"]["fits"] is None  # unknown is not "fits"
+    # ranking: most headroom first, unknowns last
+    order = [r["variant"] for r in out["rows"]]
+    assert order == ["small", "huge", "dark"]
+
+
+def test_require_rejects_oversized_and_accepts_fitting(fit, artifact):
+    # the acceptance bar: a provably-oversized config is REJECTED...
+    rc = fit.main(["--profile", artifact, "--hbm-bytes", "1e6",
+                   "--require", "huge"])
+    assert rc == 3
+    # ...while a fitting one ranks and passes
+    rc = fit.main(["--profile", artifact, "--hbm-bytes", "1e6",
+                   "--require", "small"])
+    assert rc == 0
+    # unknown variant name is a usage error, not a silent pass
+    rc = fit.main(["--profile", artifact, "--hbm-bytes", "1e6",
+                   "--require", "nope"])
+    assert rc == 2
+    # a variant with no memory model does NOT pass --require
+    rc = fit.main(["--profile", artifact, "--hbm-bytes", "1e6",
+                   "--require", "dark"])
+    assert rc == 3
+
+
+def test_no_budget_on_cpu_is_informational(fit, artifact, capsys):
+    rc = fit.main(["--profile", artifact])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "no HBM budget" in out and "--hbm-bytes" in out
+
+
+def test_baseline_check_workflow(fit, artifact, tmp_path, capsys):
+    base = str(tmp_path / "membase.json")
+    assert fit.main(["--profile", artifact, "--update-baseline",
+                     "--baseline", base]) == 0
+    doc = json.load(open(base))
+    assert set(doc["variants"]) == {"small", "huge"}  # dark: no model
+
+    # clean re-check
+    assert fit.main(["--profile", artifact, "--check",
+                     "--baseline", base, "--hbm-bytes", "1e6"]) == 0
+    capsys.readouterr()
+
+    # regress one variant beyond tolerance → exit 1 naming it
+    doc["variants"]["small"]["peak_bytes"] = 100
+    json.dump(doc, open(base, "w"))
+    rc = fit.main(["--profile", artifact, "--check",
+                   "--baseline", base, "--hbm-bytes", "1e6"])
+    assert rc == 1
+    assert "small" in capsys.readouterr().out
+
+    # a variant missing from the baseline (new) also fails the check
+    del doc["variants"]["huge"]
+    doc["variants"]["small"]["peak_bytes"] = 1_000
+    json.dump(doc, open(base, "w"))
+    rc = fit.main(["--profile", artifact, "--check",
+                   "--baseline", base, "--hbm-bytes", "1e6"])
+    assert rc == 1
+    assert "not covered" in capsys.readouterr().out
+
+    # missing baseline file under --check = usage error
+    assert fit.main(["--profile", artifact, "--check", "--baseline",
+                     str(tmp_path / "absent.json")]) == 2
+
+
+def test_topology_gate(fit, tmp_path, capsys):
+    from fluxdistributed_tpu.obs.profile import Profile
+
+    prof = Profile(fingerprint="deadbeefdeadbeef",
+                   topology={"platform": "tpu", "device_count": 256})
+    path = str(tmp_path / "foreign.json")
+    prof.save(path)
+    with pytest.raises(SystemExit, match="does not match"):
+        fit.main(["--profile", path, "--hbm-bytes", "1e6"])
+    # --allow-mismatch downgrades the gate to a loud warning
+    rc = fit.main(["--profile", path, "--hbm-bytes", "1e6",
+                   "--allow-mismatch"])
+    assert rc == 0
+    assert "topology gate skipped" in capsys.readouterr().err
+
+
+def test_committed_baseline_covers_every_registered_variant():
+    """The CI-gated invariant: the committed memory baseline names
+    every program the variant registry builds — a newly registered
+    variant without a baseline entry must fail the --check before it
+    reaches CI."""
+    from fluxdistributed_tpu.analysis.variants import (
+        VARIANT_BUILDERS, variant_names)
+
+    base = json.load(open(os.path.join(
+        REPO, "fluxdistributed_tpu", "analysis", "memory_baseline.json")))
+    covered = set(base["variants"])
+    # per-builder program names are prefixed by the registry name
+    # (serve pools register several programs per builder)
+    for name in variant_names():
+        assert any(v == name or v.startswith(name + ":")
+                   for v in covered), (
+            f"variant {name!r} has no memory-baseline entry — run "
+            "bin/fit.py --collect ... --update-baseline")
+    assert base["schema"] == "fdtpu-membaseline/v1"
+    assert VARIANT_BUILDERS  # the registry itself stays non-empty

@@ -122,6 +122,27 @@ def test_int8_cache_bytes_at_least_halved():
         kv_row_bytes(hkv, dh, "int08", 4)
 
 
+def test_kv_cache_bytes_predicted_parity_both_layouts():
+    """ONE source of truth for KV sizing: the engine's measured
+    ``kv_cache_bytes()['reserved']`` (counted off the live cache
+    leaves, scale leaves included) equals the layout's own
+    ``reserved_kv_bytes`` model (its ``predicted`` key) — pinned in
+    BOTH layouts for every kv_quant scenario, GQA included, so the
+    figure admission control sizes pools with can never drift from
+    what the benches and /healthz report."""
+    from fluxdistributed_tpu.serve import LMEngine
+
+    model, params = _model_params(num_kv_heads=2)
+    for layout_kw in ({}, {"layout": "paged", "kv_block_size": 8}):
+        for kvd in (None, "int8", "fp8"):
+            eng = LMEngine(model, params, max_slots=2, max_len=64,
+                           kv_dtype=kvd, **layout_kw)
+            m = eng.kv_cache_bytes()
+            assert m["reserved"] == m["predicted"], (
+                layout_kw, kvd, m)
+            assert m["live"] <= m["reserved"]
+
+
 def test_validation():
     model, _ = _model_params()
     with pytest.raises(ValueError, match="decode=True"):

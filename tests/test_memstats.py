@@ -1,0 +1,351 @@
+"""Memory observability (obs/memstats.py + compat shims): the static
+memory model, the None-safe HBM gauges, the watchdog's OOM-margin
+alert, the baseline workflow, profile v2, and the graceful-degradation
+contract (no memory model anywhere → everything reports "unavailable",
+nothing crashes).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fluxdistributed_tpu import compat
+from fluxdistributed_tpu.obs import memstats
+from fluxdistributed_tpu.obs.metrics import Registry
+from fluxdistributed_tpu.obs.watchdog import StepWatchdog
+
+FAKE_STATS = [
+    {"device": 0, "kind": "fake-tpu", "bytes_in_use": 6_000,
+     "peak_bytes_in_use": 9_000, "bytes_limit": 10_000},
+    {"device": 1, "kind": "fake-tpu", "bytes_in_use": 9_800,
+     "peak_bytes_in_use": 9_900, "bytes_limit": 10_000},
+]
+
+
+# ---- static model ---------------------------------------------------------
+
+def test_tree_bytes_exact_on_eval_shape():
+    tree = jax.eval_shape(
+        lambda: {"a": jnp.zeros((4, 8), jnp.float32),
+                 "b": jnp.zeros((3,), jnp.int8),
+                 "none": None})
+    assert memstats.tree_bytes(tree) == 4 * 8 * 4 + 3
+
+
+def test_state_bytes_breakdown():
+    class S:
+        params = {"w": jnp.zeros((8, 8), jnp.float32)}
+        opt_state = {"m": jnp.zeros((8, 8), jnp.float32),
+                     "v": jnp.zeros((8, 8), jnp.float32)}
+        model_state = {}
+
+    sb = memstats.state_bytes(S())
+    assert sb["param_bytes"] == 256
+    assert sb["opt_state_bytes"] == 512
+    assert sb["total_bytes"] == 768
+
+
+def test_step_memory_real_program():
+    f = jax.jit(lambda x: (x * 2.0).sum())
+    mem = memstats.step_memory(f, (jnp.zeros((16, 16), jnp.float32),))
+    assert mem is not None
+    for key in ("argument_bytes", "output_bytes", "temp_bytes",
+                "alias_bytes", "peak_bytes"):
+        assert isinstance(mem[key], int), key
+    assert mem["argument_bytes"] == 16 * 16 * 4
+    assert mem["peak_bytes"] == (mem["argument_bytes"]
+                                 + mem["output_bytes"]
+                                 + mem["temp_bytes"] - mem["alias_bytes"])
+
+
+def test_step_memory_unavailable_paths(monkeypatch):
+    # a callable that cannot lower → None, never a raise
+    assert memstats.step_memory(lambda x: x, (1,)) is None
+
+    # a jax build whose Compiled lacks/breaks memory_analysis → None
+    class NoMA:
+        pass
+
+    class RaisingMA:
+        def memory_analysis(self):
+            raise RuntimeError("unimplemented on this backend")
+
+    class NoneMA:
+        def memory_analysis(self):
+            return None
+
+    for compiled in (NoMA(), RaisingMA(), NoneMA()):
+        assert compat.compiled_memory_analysis(compiled) is None
+    f = jax.jit(lambda x: x * 2)
+    args = (jnp.zeros((2,)),)
+    monkeypatch.setattr(compat, "compiled_memory_analysis",
+                        lambda compiled: None)
+    assert memstats.step_memory(f, args) is None
+
+
+# ---- live telemetry (CPU = unavailable; fakes = available) ----------------
+
+def test_device_memory_stats_none_safe_on_cpu():
+    # this suite runs on CPU: the shim must report absence, not crash
+    for dev in jax.local_devices():
+        assert compat.device_memory_stats(dev) is None
+    assert memstats.hbm_device_stats() is None
+    assert memstats.hbm_summary() == {"available": False}
+    assert memstats.min_headroom_ratio() is None
+
+
+def test_hbm_gauges_unavailable_report(monkeypatch):
+    reg = Registry()
+    g = memstats.HbmGauges(reg)
+    assert g.available is False
+    text = reg.prometheus_text()
+    # the availability flag IS the "unavailable" report; no fake
+    # zero-byte per-device series appear
+    assert "fdtpu_hbm_available 0" in text
+    assert "fdtpu_hbm_bytes_in_use" not in text
+    assert math.isnan(reg.value("fdtpu_hbm_headroom_ratio"))
+    assert g.summary() == {"available": False}
+    g.close()
+    assert reg.get("fdtpu_hbm_available") is None
+
+
+def test_hbm_gauges_live_values(monkeypatch):
+    monkeypatch.setattr(memstats, "hbm_device_stats", lambda: FAKE_STATS)
+    reg = Registry()
+    g = memstats.HbmGauges(reg)
+    assert g.available is True
+    assert reg.value("fdtpu_hbm_available") == 1
+    assert reg.value("fdtpu_hbm_bytes_in_use", "0") == 6_000
+    assert reg.value("fdtpu_hbm_bytes_peak", "1") == 9_900
+    assert reg.value("fdtpu_hbm_bytes_limit", "0") == 10_000
+    # headroom = min over devices = device 1's 2%
+    assert reg.value("fdtpu_hbm_headroom_ratio") == pytest.approx(0.02)
+    s = g.summary()
+    assert s["available"] and s["min_headroom_ratio"] == pytest.approx(
+        0.02)
+    assert s["peak_bytes_in_use_max"] == 9_900
+    # scrape-time truth: mutate the fake, the gauge follows once the
+    # per-scrape sweep memo (SWEEP_TTL_SECONDS — one device sweep
+    # serves a whole render, not one per cell) expires
+    FAKE_STATS[1]["bytes_in_use"] = 5_000
+    g._sweep_at = 0.0  # expire the memo deterministically
+    try:
+        assert reg.value("fdtpu_hbm_headroom_ratio") == pytest.approx(0.4)
+    finally:
+        FAKE_STATS[1]["bytes_in_use"] = 9_800
+
+
+# ---- watchdog OOM-margin alert -------------------------------------------
+
+def test_watchdog_headroom_episode_semantics(capsys):
+    reg = Registry()
+    wd = StepWatchdog(registry=reg, headroom_warn=0.05)
+    # unavailable → no-op: gauge stays NaN, no episode
+    assert wd.note_headroom(None) is False
+    assert math.isnan(reg.value("fdtpu_hbm_headroom_ratio"))
+    # healthy margin: gauge tracks, no alert
+    assert wd.note_headroom(0.5) is False
+    assert reg.value("fdtpu_hbm_headroom_ratio") == 0.5
+    assert reg.value("fdtpu_watchdog_low_headroom_total") == 0
+    # low margin: ONE warning per episode, not one per step
+    assert wd.note_headroom(0.02) is True
+    assert wd.note_headroom(0.01) is False
+    assert wd.note_headroom(0.02) is False
+    assert reg.value("fdtpu_watchdog_low_headroom_total") == 1
+    assert "LOW HBM HEADROOM" in capsys.readouterr().err
+    # recovery re-arms: the next dip is a NEW episode
+    assert wd.note_headroom(0.5) is False
+    assert wd.note_headroom(0.03) is True
+    assert reg.value("fdtpu_watchdog_low_headroom_total") == 2
+    # headroom_warn=0 disables the alert, gauge stays live
+    wd2 = StepWatchdog(registry=Registry(), headroom_warn=0.0)
+    assert wd2.note_headroom(0.001) is False
+    with pytest.raises(ValueError, match="headroom_warn"):
+        StepWatchdog(headroom_warn=1.5)
+
+
+# ---- baseline workflow ----------------------------------------------------
+
+def _mem(peak):
+    return {"memory": {"peak_bytes": peak, "argument_bytes": 1,
+                       "output_bytes": 1, "temp_bytes": 1,
+                       "alias_bytes": 0}}
+
+
+def test_check_memory_baseline_semantics():
+    baseline = memstats.build_baseline(
+        {"a": _mem(1000), "b": _mem(2000)}, tolerance=0.5)
+    assert baseline["schema"] == memstats.BASELINE_SCHEMA
+
+    # unchanged → clean
+    res = memstats.check_memory_baseline(
+        {"a": _mem(1000), "b": _mem(2000)}, baseline)
+    assert res["failures"] == [] and res["checked"] == 2
+
+    # within tolerance → clean; beyond → the regression failure
+    ok = memstats.check_memory_baseline({"a": _mem(1400),
+                                         "b": _mem(2000)}, baseline)
+    assert ok["failures"] == []
+    bad = memstats.check_memory_baseline({"a": _mem(1600),
+                                          "b": _mem(2000)}, baseline)
+    assert len(bad["failures"]) == 1 and "regressed" in bad["failures"][0]
+
+    # a NEW variant the baseline does not cover fails (CI forces the
+    # baseline to stay exhaustive), a stale entry only notes
+    new = memstats.check_memory_baseline(
+        {"a": _mem(1000), "c": _mem(10)}, baseline)
+    assert any("not covered" in f for f in new["failures"])
+    assert any("stale" in n for n in new["notes"])
+
+    # unavailable memory model → note, never a failure
+    degraded = memstats.check_memory_baseline(
+        {"a": {"memory": None}, "b": _mem(2000)}, baseline)
+    assert degraded["failures"] == []
+    assert any("unavailable" in n for n in degraded["notes"])
+
+    # shrinkage notes (re-record hint), never fails
+    shrunk = memstats.check_memory_baseline(
+        {"a": _mem(100), "b": _mem(2000)}, baseline)
+    assert shrunk["failures"] == []
+    assert any("shrank" in n for n in shrunk["notes"])
+
+
+# ---- profile v2 -----------------------------------------------------------
+
+def test_profile_v2_roundtrip_and_v1_accepted(tmp_path):
+    from fluxdistributed_tpu.compilation import topology_fingerprint
+    from fluxdistributed_tpu.obs.profile import ACCEPTED_SCHEMAS, Profile
+
+    p2 = tmp_path / "v2.json"
+    prof = Profile(
+        fingerprint=topology_fingerprint(),
+        memory={"state": {"param_bytes": 7}, "step": None,
+                "variants": {"dp": {"memory": {"peak_bytes": 5}}}},
+        comms={"step": {"jaxpr": [{"kind": "all_reduce", "axes": ["data"],
+                                   "count": 1, "bytes": 4,
+                                   "bytes_per_call": 4}]},
+               "variants": {}},
+    )
+    prof.save(str(p2))
+    back = Profile.load(str(p2)).verify()
+    assert back.schema == "fdtpu-profile/v2"
+    assert back.memory == prof.memory and back.comms == prof.comms
+
+    # a v1 artifact (no memory/comms keys) still loads — additive schema
+    doc = json.loads(p2.read_text())
+    doc["schema"] = "fdtpu-profile/v1"
+    del doc["memory"], doc["comms"]
+    p1 = tmp_path / "v1.json"
+    p1.write_text(json.dumps(doc))
+    old = Profile.load(str(p1)).verify()
+    assert old.schema == "fdtpu-profile/v1"
+    assert old.memory == {} and old.comms == {}
+    assert "fdtpu-profile/v1" in ACCEPTED_SCHEMAS
+
+    # anything else is still rejected with the actionable message
+    doc["schema"] = "fdtpu-profile/v0"
+    p0 = tmp_path / "v0.json"
+    p0.write_text(json.dumps(doc))
+    with pytest.raises(ValueError, match="not a .*artifact"):
+        Profile.load(str(p0))
+
+
+# ---- serve /healthz + scheduler degradation -------------------------------
+
+def test_serve_healthz_memory_block():
+    """The LMServer memory block: unavailable on CPU but present, KV
+    figures riding along when the engine reports them; a broken
+    telemetry read degrades to {'available': False} instead of taking
+    down /healthz."""
+    from fluxdistributed_tpu.serve.scheduler import Scheduler
+    from fluxdistributed_tpu.serve.server import LMServer
+    from fluxdistributed_tpu.serve.testing import FakeLMEngine
+
+    sched = Scheduler(FakeLMEngine(max_slots=2), max_queue=4)
+    srv = LMServer(sched, vocab=32)
+    block = srv._memory_block()
+    assert block["available"] is False
+    # the scheduler's registry carries the availability flag + NaN
+    # headroom (the gauges' "unavailable" report) and close() detaches
+    text = sched.registry.prometheus_text()
+    assert "fdtpu_hbm_available 0" in text
+    sched.close()
+    assert sched.registry.get("fdtpu_hbm_available") is None
+
+    class BrokenEngine(FakeLMEngine):
+        def kv_cache_bytes(self):
+            raise RuntimeError("boom")
+
+    srv2 = LMServer(Scheduler(BrokenEngine(max_slots=2), max_queue=4),
+                    vocab=32)
+    assert srv2._memory_block() == {"available": False}
+
+
+def test_scheduler_kv_byte_gauges():
+    from fluxdistributed_tpu.serve.scheduler import Scheduler
+    from fluxdistributed_tpu.serve.testing import FakeLMEngine
+
+    class KVEngine(FakeLMEngine):
+        def kv_cache_bytes(self):
+            return {"reserved": 1024, "live": 256, "predicted": 1024}
+
+    sched = Scheduler(KVEngine(max_slots=2), max_queue=4)
+    assert sched.registry.value(
+        "fdtpu_serve_kv_cache_reserved_bytes") == 1024
+    assert sched.registry.value("fdtpu_serve_kv_cache_live_bytes") == 256
+    # engines without the method read 0, not a crash
+    sched2 = Scheduler(FakeLMEngine(max_slots=2), max_queue=4)
+    assert sched2.registry.value(
+        "fdtpu_serve_kv_cache_reserved_bytes") == 0
+
+
+# ---- pp_plan cross-validation --------------------------------------------
+
+def test_pp_plan_memory_check_band():
+    """The tentpole loop-closer: the planner's per-stage byte estimate
+    against XLA's memory_analysis of the REAL planned step, inside the
+    documented band (PP_MEMORY_FACTOR: the estimate is the schedule's
+    working-set lower bound; the measured peak adds grads, moments and
+    temps — ≤ 8x the modeled total)."""
+    from fluxdistributed_tpu import mesh as mesh_lib, optim
+    from fluxdistributed_tpu.data.synthetic import SyntheticTextDataset
+    from fluxdistributed_tpu.models.transformer_lm import TransformerLM
+    from fluxdistributed_tpu.parallel.pp_plan import plan_from_model
+    from fluxdistributed_tpu.train.trainer import (
+        _dummy_batch, prepare_training)
+
+    model = TransformerLM(vocab=64, dim=16, depth=6, num_heads=2,
+                          mlp_dim=32, dtype=jnp.float32, dropout=0.0)
+    ds = SyntheticTextDataset(vocab=64, seqlen=16)
+    mesh = mesh_lib.make_mesh(
+        {mesh_lib.DATA_AXIS: 2, mesh_lib.PIPE_AXIS: 4})
+    plan = plan_from_model(model, 4, 2, batch_size=8, seqlen=16)
+    assert plan.stage_bytes and max(plan.stage_bytes) > 0
+    task = prepare_training(
+        model, ds, optim.adam(1e-3), mesh=mesh, batch_size=16, cycles=1,
+        donate=True, spmd="pp_1f1b", num_microbatches=2, topk=(),
+        pp_plan=plan)
+    batch = _dummy_batch(ds, None, 16, mesh, 1, seed=0)
+    report = memstats.pp_plan_memory_check(
+        plan, task.step_fn, (task.state, batch))
+    assert report["measured"] is not None
+    assert report["within"] is True, report
+    # the band really is a band: a degenerate factor must fail it
+    tight = memstats.pp_plan_memory_check(
+        plan, task.step_fn, (task.state, batch), factor=0.001)
+    assert tight["within"] is False
+
+
+def test_pp_plan_memory_check_degrades(monkeypatch):
+    from fluxdistributed_tpu.parallel.pp_plan import plan_stages
+
+    plan = plan_stages([1.0] * 4, 2, 2, block_bytes=[10.0] * 4)
+    monkeypatch.setattr(memstats, "step_memory",
+                        lambda fn, args, compiled=None: None)
+    report = memstats.pp_plan_memory_check(plan, None, ())
+    assert report["within"] is None and report["measured"] is None

@@ -90,6 +90,15 @@ def test_train_smoke_populates_registry_and_trace(mesh, tmp_path):
     assert prof.static["step"]["flops"] > 0
     assert prof.measured["phases"]["dispatch"]["count"] >= 6
     assert prof.meta["model"] == "SimpleCNN" and prof.meta["steps"] == 6
+    # the v2 sections: exact state bytes, the step's memory_analysis
+    # breakdown, and the compiled step's collective ledger (the GSPMD
+    # dp step all-reduces its gradients over the 8-device data axis)
+    assert prof.schema == "fdtpu-profile/v2"
+    assert prof.memory["state"]["param_bytes"] > 0
+    assert prof.memory["step"] is None or (
+        prof.memory["step"]["peak_bytes"] > 0)
+    hlo = {e["kind"] for e in prof.comms["step"].get("hlo", [])}
+    assert "all_reduce" in hlo
 
 
 def test_train_metrics_scrapeable_over_http(mesh):

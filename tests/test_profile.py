@@ -87,7 +87,7 @@ def test_profile_save_load_round_trip(tmp_path):
     prof.save(path)
     # on-disk: strict JSON with the documented schema tag
     doc = json.loads(open(path).read())
-    assert doc["schema"] == "fdtpu-profile/v1"
+    assert doc["schema"] == "fdtpu-profile/v2"
     assert doc["created_unix"] > 0
     back = Profile.load(path)
     assert back.fingerprint == prof.fingerprint
