@@ -94,6 +94,7 @@ def build_step(
     fuse: int = 1,
     s2d: bool = False,
     zero1: bool = False,
+    layout: str | None = None,
 ):
     """Build the headline measurement target: ResNet-50, DP mesh over all
     chips, compiled train step, device-resident batch.
@@ -112,7 +113,21 @@ def build_step(
     from fluxdistributed_tpu.parallel import TrainState, make_train_step
     from fluxdistributed_tpu.parallel.dp import flax_loss_fn
 
-    mesh = fd.data_mesh()
+    lay = None
+    if layout:
+        # rule-derived dp x fsdp x tp placement (parallel/layout.py):
+        # the mesh and the state shardings come from the preset's rule
+        # table + fsdp overlay — sweep rows measure the SAME step math
+        # under a different placement
+        from fluxdistributed_tpu.parallel import layout as layout_lib
+
+        if zero1:
+            raise ValueError("layout= and zero1= are exclusive (a "
+                             "layout's fsdp axis shards the optimizer)")
+        lay = layout_lib.resolve_layout(layout)
+        mesh = lay.build_mesh()
+    else:
+        mesh = fd.data_mesh()
     model = resnet50(
         num_classes=1000, norm_dtype=norm_dtype, remat=remat,
         space_to_depth=s2d,
@@ -143,6 +158,19 @@ def build_step(
         step = zero1_lib.make_train_step_zero1(
             loss_fn, opt, mesh, z_sh, donate=donate, accum_steps=accum_steps
         )
+    elif lay is not None:
+        from fluxdistributed_tpu.parallel import layout as layout_lib
+
+        state = TrainState.create(params, opt, model_state=mstate)
+        spec_state = layout_lib.state_specs_for(
+            model, state, lay, mesh)
+        sh = sharding.make_shardings(spec_state, mesh)
+        state = jax.tree.map(
+            lambda v, s: jax.device_put(sharding.unaliased(v), s),
+            state, sh)
+        step = make_train_step(
+            loss_fn, opt, mesh, axis=lay.batch_axes, donate=donate,
+            accum_steps=accum_steps, state_shardings=sh)
     else:
         step = make_train_step(loss_fn, opt, mesh, donate=donate, accum_steps=accum_steps)
         state = TrainState.create(
@@ -152,7 +180,8 @@ def build_step(
     # so an f32 feed only adds a 2x-wider HBM read + an in-graph convert
     xb = x if input_f32 else x.astype(jnp.bfloat16)
     b = sharding.shard_batch(
-        {"image": xb, "label": np.asarray(fd.onehot(y, 1000))}, mesh
+        {"image": xb, "label": np.asarray(fd.onehot(y, 1000))}, mesh,
+        axis=(lay.batch_axes if lay is not None else "data"),
     )
     if fuse > 1:
         step = fuse_steps(step, fuse, donate=donate)
@@ -280,6 +309,57 @@ def pp_plan_stamp():
             "modeled_bubble_planned": round(plan.modeled_bubble, 4),
             "modeled_bubble_uniform": round(plan.uniform_bubble, 4),
         }
+    except Exception as e:  # noqa: BLE001 — stamp is best-effort
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def layout_pick_stamp():
+    """The auto-layout picker's verdict for the bench JSON
+    (parallel/layout.py): chosen dp x fsdp x tp layout for the bench-
+    shaped LM on THIS topology, with each candidate's peak bytes /
+    headroom and collective-ledger figures.  Budget comes from the live
+    per-device ``bytes_limit`` when the backend reports one (real
+    chips); without it (CPU) the ranking is by collective bytes alone,
+    honestly flagged.  Prices candidates by ABSTRACT compiles (no
+    parameter buffer allocates) — bounded cost, and like the lint/
+    guard/memory stamps it never raises: dead rounds record what the
+    picker would have chosen next to why the round died."""
+    try:
+        import jax
+        import numpy as np
+
+        from fluxdistributed_tpu import optim
+        from fluxdistributed_tpu.models.transformer_lm import lm_tiny
+        from fluxdistributed_tpu.parallel import layout as layout_lib
+
+        model = lm_tiny(dropout=0.0)
+        batch = {"tokens": jax.ShapeDtypeStruct((16, 128), np.int32)}
+        rep = layout_lib.pick(model, batch, optim.adam(1e-3))
+        rows = [{k: r.get(k) for k in (
+                    "layout", "peak_bytes", "headroom_bytes", "fits",
+                    "comms_bytes", "comms_bytes_per_axis", "invalid")
+                 if r.get(k) is not None}
+                for r in rep.rows]
+        return {"chosen": rep.chosen.name if rep.chosen else None,
+                "chosen_sizes": rep.chosen.sizes if rep.chosen else None,
+                "budget_bytes": rep.budget_bytes,
+                "reason": rep.reason,
+                "rows": rows}
+    except Exception as e:  # noqa: BLE001 — stamp is best-effort
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def layout_pick_stamp_bounded(seconds: float = 120.0):
+    """The picker stamp under a wall bound — error-path JSON must not
+    hang behind a wedged backend's compile attempt (the picker prices
+    candidates by compiling; a dead tunneled chip can block that in C).
+    A timeout records itself instead of wedging the error report."""
+    try:
+        from fluxdistributed_tpu import faults
+
+        return faults.with_retries(
+            layout_pick_stamp, tries=1, timeout=seconds,
+            site="bench.layout_stamp")
     except Exception as e:  # noqa: BLE001 — stamp is best-effort
         return {"error": f"{type(e).__name__}: {e}"[:200]}
 
@@ -547,6 +627,7 @@ def resumable_main(argv=None) -> int:
             "lint": lint_stamp(),
             "guard": guard_stamp(),
             "memory": memory_stamp(state),
+            "layout_pick": layout_pick_stamp(),
         }))
         return 0
     except BaseException as e:  # noqa: BLE001 — always emit the JSON line
@@ -570,6 +651,9 @@ def resumable_main(argv=None) -> int:
             "guard": guard_stamp(),
             # memory state at death: live HBM peak when available
             "memory": memory_stamp(),
+            # what the picker WOULD have chosen here (wall-bounded —
+            # a wedged backend's compile must not hang the error line)
+            "layout_pick": layout_pick_stamp_bounded(),
         }))
         return 0
 
@@ -662,6 +746,10 @@ def _measure():
         # planner paired row: uniform vs planned modeled bubble for a
         # production-shaped LM on this box's static costs
         "pp_plan": pp_plan_stamp(),
+        # auto-layout picker verdict: chosen dp x fsdp x tp layout for
+        # the bench-shaped LM on THIS topology, with each candidate's
+        # headroom + collective-ledger figures (parallel/layout.py)
+        "layout_pick": layout_pick_stamp(),
     }
 
 
@@ -760,6 +848,10 @@ def main():
         # and the CHILD's memory state at its last snapshot — dead hw
         # rounds record the HBM picture at death, not the parent's
         "memory": status.get("memory", memory_stamp()),
+        # the layout the picker would have chosen on this topology
+        # (wall-bounded: the parent error path follows a child that
+        # may have died on a wedged backend)
+        "layout_pick": layout_pick_stamp_bounded(),
     }
     # If a background probe loop has been retrying the chip (the r4+
     # availability workflow: benchmarks/hw_watch.sh, docs/benchmarks.md),

@@ -38,22 +38,22 @@ fits() { # fits <seconds>: does a stage bounded at <seconds> fit?
     return 0
 }
 
-echo "[$(stamp)] 1/9 headline bench" | tee -a "$OUT/session.log"
+echo "[$(stamp)] 1/10 headline bench" | tee -a "$OUT/session.log"
 fits 3000 && timeout 3000 python bench.py >> "$OUT/bench.jsonl" 2>> "$OUT/session.log"
 
-echo "[$(stamp)] 2/9 step sweep (leverage-ordered; fuse rows isolate tunnel dispatch)" | tee -a "$OUT/session.log"
+echo "[$(stamp)] 2/10 step sweep (leverage-ordered; fuse rows isolate tunnel dispatch)" | tee -a "$OUT/session.log"
 # no outer timeout: every sweep child self-bounds at 1800s and the
 # parent stops between children once SWEEP_DEADLINE_EPOCH approaches —
 # killing the parent would orphan a TPU child still holding the grant
 fits 1800 && SWEEP_DEADLINE_EPOCH="$DEADLINE" python benchmarks/step_sweep.py >> "$OUT/sweep.jsonl" 2>> "$OUT/session.log"
 
-echo "[$(stamp)] 3/9 trace analysis" | tee -a "$OUT/session.log"
+echo "[$(stamp)] 3/10 trace analysis" | tee -a "$OUT/session.log"
 fits 3600 && timeout 3600 python benchmarks/trace_analysis.py >> "$OUT/trace.txt" 2>> "$OUT/session.log"
 
-echo "[$(stamp)] 4/9 step segments + cost analysis" | tee -a "$OUT/session.log"
+echo "[$(stamp)] 4/10 step segments + cost analysis" | tee -a "$OUT/session.log"
 fits 3600 && timeout 3600 python benchmarks/train_step_segments.py >> "$OUT/segments.txt" 2>> "$OUT/session.log"
 
-echo "[$(stamp)] 5/9 LM benches" | tee -a "$OUT/session.log"
+echo "[$(stamp)] 5/10 LM benches" | tee -a "$OUT/session.log"
 fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 1024 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
 fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 2048 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
 fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_medium --seqlen 1024 --batch 8 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
@@ -69,11 +69,11 @@ fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqle
 fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 4096 --batch 8 --attn flash --window 1024 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
 fits 2700 && timeout 2700 python benchmarks/lm_bench.py --model lm_small --seqlen 2048 --attn flash --kv-heads 3 >> "$OUT/lm.jsonl" 2>> "$OUT/session.log"
 
-echo "[$(stamp)] 6/9 end-to-end ingest" | tee -a "$OUT/session.log"
+echo "[$(stamp)] 6/10 end-to-end ingest" | tee -a "$OUT/session.log"
 fits 3600 && timeout 3600 python benchmarks/ingest_e2e.py --steps 20 >> "$OUT/ingest.jsonl" 2>> "$OUT/session.log"
 fits 3600 && timeout 3600 python benchmarks/ingest_e2e.py --steps 20 --s2d >> "$OUT/ingest.jsonl" 2>> "$OUT/session.log"
 
-echo "[$(stamp)] 7/9 attention-core microbench (incl. windowed-flash row)" | tee -a "$OUT/session.log"
+echo "[$(stamp)] 7/10 attention-core microbench (incl. windowed-flash row)" | tee -a "$OUT/session.log"
 fits 2700 && timeout 2700 python benchmarks/attention_bench.py --window 1024 >> "$OUT/attention.jsonl" 2>> "$OUT/session.log"
 # flash-DECODE kernels on hardware (first compiled-Pallas decode rows:
 # dense cursor-skip / windowed ring+sinks / paged page-table walk vs the
@@ -86,7 +86,7 @@ fits 2700 && timeout 2700 python benchmarks/attention_bench.py --decode --max-le
 # Every run also emits the paged-vs-dense layout rows (KV bytes per
 # live token + short-TTFT-behind-long-prompt); the third run sizes a
 # realistic paged pool to put real HBM numbers behind the CPU ratios.
-echo "[$(stamp)] 8/9 decode / serving bench" | tee -a "$OUT/session.log"
+echo "[$(stamp)] 8/10 decode / serving bench" | tee -a "$OUT/session.log"
 fits 2700 && timeout 2700 python benchmarks/decode_bench.py --model lm_small --vocab 32000 --prompt-len 128 --new-tokens 256 >> "$OUT/decode.jsonl" 2>> "$OUT/session.log"
 fits 2700 && timeout 2700 python benchmarks/decode_bench.py --model lm_small --vocab 32000 --prompt-len 128 --new-tokens 256 --window 1024 --sinks 4 >> "$OUT/decode.jsonl" 2>> "$OUT/session.log"
 fits 2700 && timeout 2700 python benchmarks/decode_bench.py --model lm_small --vocab 32000 --prompt-len 256 --new-tokens 256 --kv-block-size 32 --prefill-chunk 128 --kv-blocks 96 >> "$OUT/decode.jsonl" 2>> "$OUT/session.log"
@@ -99,7 +99,16 @@ fits 2700 && timeout 2700 python benchmarks/decode_bench.py --model lm_small --v
 # error), and the realistic 32k vocab makes the embed/head skew the
 # planner exists to fix actually present.  The profile artifact feeds
 # later --profile replays and --pp-plan runs.
-echo "[$(stamp)] 9/9 pipeline planner / zero-bubble bench" | tee -a "$OUT/session.log"
+echo "[$(stamp)] 9/10 pipeline planner / zero-bubble bench" | tee -a "$OUT/session.log"
 fits 2700 && timeout 2700 python benchmarks/pp_bubble.py --schedule 1f1b --plan auto --with-zb --depth 32 --vocab 32000 --seconds 5 --profile-out "$OUT/pp_profile.json" >> "$OUT/pp.jsonl" 2>> "$OUT/session.log"
 
-echo "[$(stamp)] session complete (incl. decode + pp planner)" | tee -a "$OUT/session.log"
+# auto-layout picker on the real topology: price every dp x fsdp x tp
+# candidate against the chip's ACTUAL bytes_limit (no --hbm-bytes
+# needed on hardware), train a few cycles with the chosen layout, and
+# keep the ranking artifact — the first hardware row where "fit this
+# model on this topology" is one flag (parallel/layout.py; CPU-mesh
+# rankings live in tests/test_layout.py and the CI report)
+echo "[$(stamp)] 10/10 auto-layout picker + rule-derived training" | tee -a "$OUT/session.log"
+fits 2700 && timeout 2700 python bin/driver.py --model lm_small --dataset synthetic-text --vocab 32000 --seqlen 1024 --batch-size 32 --cycles 20 --layout auto --layout-report "$OUT/layout_pick.json" >> "$OUT/layout.jsonl" 2>> "$OUT/session.log"
+
+echo "[$(stamp)] session complete (incl. decode + pp planner + layout pick)" | tee -a "$OUT/session.log"

@@ -55,6 +55,13 @@ CONFIGS = [
     # reduce-scatter+all-gather vs all-reduce trade at DP numerics
     {"name": "zero1", "env": {"SWEEP_ZERO1": "1"}},
     {"name": "zero1-512", "env": {"SWEEP_ZERO1": "1", "SWEEP_BATCH": "512"}},
+    # rule-derived dp x fsdp layouts (parallel/layout.py): the SAME dp
+    # step math under ZeRO-3-style placement from the declarative rule
+    # tables — measures the all-gather/reduce-scatter trade the layout
+    # picker's ledger models, on the real chip
+    {"name": "layout-fsdp", "env": {"SWEEP_LAYOUT": "fsdp"}},
+    {"name": "layout-dp-fsdp-512", "env": {
+        "SWEEP_LAYOUT": "dp_fsdp", "SWEEP_BATCH": "512"}},
     {"name": "batch-512", "env": {"SWEEP_BATCH": "512"}},
     {"name": "lhs-batch-512", "env": {
         "SWEEP_BATCH": "512",
@@ -99,6 +106,7 @@ def measure_one() -> dict:
         fuse=fuse,
         s2d=_env_flag("SWEEP_S2D"),
         zero1=_env_flag("SWEEP_ZERO1"),
+        layout=os.environ.get("SWEEP_LAYOUT") or None,
     )
     dt, _ = bench.time_compiled_step(
         step, state, b, target_seconds=float(os.environ.get("SWEEP_SECONDS", "2.0"))

@@ -73,6 +73,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--spmd", default="jit",
                    choices=["jit", "dp", "shard_map", "fsdp", "tp", "fsdp_tp",
                             "pp", "pp_1f1b", "ep", "sp"])
+    p.add_argument("--layout", default=None, metavar="NAME|auto",
+                   help="declarative dp x fsdp x tp layout "
+                        "(parallel/layout.py): a preset name (dp, fsdp, "
+                        "tp, dp_fsdp, fsdp_tp, dp_fsdp_tp) shards the "
+                        "model from its committed rule table + the fsdp "
+                        "overlay — NO per-model spec code; 'auto' runs "
+                        "the layout picker (prices every candidate's "
+                        "real compiled step, ranks by HBM headroom via "
+                        "the fit checker's ranking, breaks ties by the "
+                        "collective ledger) and trains with the fastest "
+                        "layout that fits.  Keep --spmd jit (default)")
+    p.add_argument("--hbm-bytes", type=float, default=None,
+                   help="per-device HBM budget in bytes for --layout "
+                        "auto (default: the live device bytes_limit; "
+                        "REQUIRED for fit verdicts on backends without "
+                        "memory_stats, e.g. the CPU mesh)")
+    p.add_argument("--layout-report", default=None, metavar="PATH",
+                   help="write the layout picker's report (chosen "
+                        "layout + per-candidate headroom/ledger "
+                        "ranking) as JSON here (--layout auto)")
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1 weight-update sharding for the DP paths "
                         "(--spmd jit/dp/shard_map): reduce-scatter grads, "
@@ -555,6 +575,18 @@ def main(argv=None) -> int:
     if args.zero1 and args.spmd not in ("jit", "dp", "shard_map"):
         raise SystemExit("--zero1 only applies with --spmd jit/dp/shard_map "
                          "(fsdp already shards the optimizer state)")
+    if args.layout is not None:
+        if args.spmd not in ("jit", "dp"):
+            raise SystemExit("--layout builds the rule-derived 3-D step "
+                             "and needs --spmd jit (the default)")
+        if args.zero1:
+            raise SystemExit("--layout cannot combine with --zero1 (a "
+                             "layout's fsdp axis already shards the "
+                             "optimizer state)")
+    if (args.hbm_bytes is not None or args.layout_report) \
+            and args.layout != "auto":
+        raise SystemExit("--hbm-bytes/--layout-report only apply with "
+                         "--layout auto")
     if args.sp_strategy != "ring" and args.spmd != "sp":
         raise SystemExit("--sp-strategy only applies with --spmd sp")
     if args.spmd in ("tp", "fsdp_tp"):
@@ -579,6 +611,46 @@ def main(argv=None) -> int:
         mesh = ep_mesh
     elif args.spmd == "sp":
         mesh = sp_mesh
+    elif args.layout is not None:
+        # declarative dp x fsdp x tp layout (rule-derived sharding);
+        # 'auto' = the picker: price every candidate's real compiled
+        # step, rank by headroom, tiebreak by the collective ledger
+        import numpy as np
+
+        from fluxdistributed_tpu.parallel import layout as layout_lib
+
+        if args.layout == "auto":
+            from fluxdistributed_tpu.data.loader import batch_to_dict
+
+            draw = dataset.batch(np.random.default_rng(0), args.batch_size)
+            bd = batch_to_dict(draw, getattr(dataset, "nclasses", None))
+            batch_struct = {
+                k: jax.ShapeDtypeStruct(np.shape(v), np.asarray(v).dtype)
+                for k, v in bd.items()}
+            try:
+                pick_report = layout_lib.pick(
+                    model, batch_struct, opt, hbm_bytes=args.hbm_bytes,
+                    loss_fn=lm_extra.get("loss_fn"))
+            except layout_lib.LayoutError as e:
+                rep = getattr(e, "report", None)
+                if rep is not None:
+                    if multihost.is_coordinator():
+                        print(rep.describe())
+                    if args.layout_report:
+                        rep.save(args.layout_report)
+                raise SystemExit(f"--layout auto: {e}")
+            chosen = pick_report.chosen
+            if multihost.is_coordinator():
+                print(pick_report.describe())
+            if args.layout_report:
+                pick_report.save(args.layout_report)
+        else:
+            try:
+                chosen = layout_lib.resolve_layout(args.layout)
+            except layout_lib.LayoutError as e:
+                raise SystemExit(f"--layout {args.layout}: {e}")
+        mesh = chosen.build_mesh()
+        lm_extra["layout"] = chosen
     else:
         mesh = fd.data_mesh()
     if multihost.is_coordinator():

@@ -23,9 +23,11 @@ topology Z, and with how much headroom?
 Exit codes: 0 = ok / informational, 1 = baseline check failed,
 2 = usage error, 3 = a --require'd variant does not fit.
 
-This is the precursor of ROADMAP item 3's auto-layout picker: the
-picker will consume the same per-variant ``peak_bytes`` + collective
-ledger this CLI ranks by hand today.
+The auto-layout picker (``parallel.layout.pick`` / ``bin/driver.py
+--layout auto``) consumes this CLI's ranking directly —
+``obs.memstats.rank_memory`` is the ONE headroom-ranking
+implementation both share — plus the per-step collective ledger as
+its tiebreak.
 """
 
 from __future__ import annotations
@@ -110,27 +112,13 @@ def _variant_memory(profile) -> dict:
 def rank_variants(profile, budget: float | None) -> list:
     """Headroom ranking rows: one per variant with a memory model,
     sorted most-headroom-first; variants whose memory_analysis was
-    unavailable rank last with fits=None (unknown is not 'fits')."""
-    rows = []
-    for name, entry in sorted(_variant_memory(profile).items()):
-        mem = entry.get("memory") if isinstance(entry, dict) else None
-        row = {"variant": name, "peak_bytes": None, "headroom_bytes": None,
-               "fits": None}
-        if mem:
-            row["peak_bytes"] = int(mem["peak_bytes"])
-            if budget is not None:
-                row["headroom_bytes"] = int(budget - mem["peak_bytes"])
-                row["fits"] = row["headroom_bytes"] >= 0
-        rows.append(row)
-    def _key(r):
-        if r["peak_bytes"] is None:
-            return (1, 0.0)  # unknowns last
-        if r["headroom_bytes"] is None:
-            return (0, float(r["peak_bytes"]))  # no budget: smallest first
-        return (0, -float(r["headroom_bytes"]))  # most headroom first
+    unavailable rank last with fits=None (unknown is not 'fits').
+    Thin adapter over ``obs.memstats.rank_memory`` — the ONE ranking
+    this CLI and the auto-layout picker (``parallel.layout.pick``)
+    share."""
+    from fluxdistributed_tpu.obs.memstats import rank_memory
 
-    rows.sort(key=_key)
-    return rows
+    return rank_memory(_variant_memory(profile), budget)
 
 
 def main(argv=None) -> int:

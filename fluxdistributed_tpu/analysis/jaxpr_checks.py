@@ -25,6 +25,14 @@ FDT204    re-tracing with identical arguments yields a different
 FDT205    executing one step under ``jax.transfer_guard("disallow")``
           raised — the program implicitly moves data between host and
           device on its hot path
+FDT108    a committed sharding rule table (``parallel/rules.py``
+          ``RULE_TABLES``) contains a DEAD rule — a pattern matching
+          no leaf on ANY of its registered probe models (a typo'd
+          path or a stale layer name shards nothing, silently) — or a
+          probe model carries a LARGE leaf no rule matches, silently
+          falling to replication (the 4 GB-embedding-on-every-device
+          trap).  Numbered 1xx (it needs no mesh) but run in this
+          layer: probing a table means eval_shape-ing real models.
 ========  =============================================================
 
 ``check_spec_tree`` is exposed directly (shapes + specs + mesh, no
@@ -50,8 +58,11 @@ __all__ = [
     "check_retrace",
     "check_transfers",
     "check_variant",
+    "check_rule_tables",
     "run_jaxpr_checks",
 ]
+
+_RULES_SRC = "fluxdistributed_tpu/parallel/rules.py"
 
 _ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
 _VARIANTS_SRC = "fluxdistributed_tpu/analysis/variants.py"
@@ -298,6 +309,96 @@ def check_transfers(v: StepVariant) -> List[Finding]:
     return []
 
 
+def check_rule_tables(tables=None) -> List[Finding]:
+    """FDT108 — sweep the committed sharding rule tables
+    (``parallel.rules.RULE_TABLES``, or ``tables`` for tests) against
+    their registered probe models.  A pattern is DEAD when it decides
+    no leaf on any probe (aggregated across the table's probes: the
+    GQA-only ``kv/kernel`` rule is alive because the GQA probe carries
+    it); a probe leaf at/above the fallback size threshold matched by
+    nothing is a silent replication — flagged unless the table opts
+    out (``check_unmatched=False``: the dp/fsdp tables replicate or
+    catch-all by DOCUMENTED design).  Probes are eval_shape'd — no
+    buffer allocates, no mesh is needed."""
+    from ..parallel import rules as rules_mod
+
+    findings: List[Finding] = []
+    for name, table in sorted((tables or
+                               rules_mod.registered_rule_tables()).items()):
+        try:
+            rule_list = table.build()
+        except Exception as e:  # noqa: BLE001 — a broken builder is a finding
+            findings.append(Finding(
+                rule="FDT108", severity="error", file=_RULES_SRC, line=0,
+                message=f"rule table {name!r} failed to build: "
+                        f"{type(e).__name__}: {str(e)[:200]}",
+                hint="run the table's build() directly for the traceback",
+                detail=f"{name}:build"))
+            continue
+        # duplicate patterns are unreachable under first-match-wins —
+        # and would also collapse in the aliveness dict below, so the
+        # stale copy's spec could silently never apply.  Flag them
+        # outright before the probe sweep.
+        seen_pats: set = set()
+        for pat, _ in rule_list:
+            if pat in seen_pats:
+                findings.append(Finding(
+                    rule="FDT108", severity="error", file=_RULES_SRC,
+                    line=0,
+                    message=f"rule table {name!r}: pattern {pat!r} "
+                            "appears more than once — the later entry "
+                            "is unreachable under first-match-wins, so "
+                            "its spec silently never applies",
+                    hint="delete the duplicate (keep whichever spec is "
+                         "intended as the single entry)",
+                    detail=f"{name}:duplicate:{pat}"))
+            seen_pats.add(pat)
+        alive = {pat: False for pat, _ in rule_list}
+        large: List[tuple] = []
+        for probe in table.probes:
+            try:
+                params, note = probe()
+            except Exception as e:  # noqa: BLE001
+                findings.append(Finding(
+                    rule="FDT108", severity="error", file=_RULES_SRC,
+                    line=0,
+                    message=f"rule table {name!r}: probe failed to "
+                            f"build: {type(e).__name__}: {str(e)[:200]}",
+                    hint="run the probe directly for the traceback",
+                    detail=f"{name}:probe"))
+                continue
+            rep = rules_mod.rule_report(rule_list, params)
+            for pat, hits in rep.matched.items():
+                if hits:
+                    alive[pat] = True
+            if table.check_unmatched:
+                large += [(note, path, n)
+                          for path, n in rep.large_unmatched]
+        for pat, hit in alive.items():
+            if not hit:
+                findings.append(Finding(
+                    rule="FDT108", severity="error", file=_RULES_SRC,
+                    line=0,
+                    message=f"rule table {name!r}: pattern {pat!r} "
+                            "matches NO leaf on any registered probe "
+                            "model — a dead rule (typo'd path or stale "
+                            "layer name shards nothing, silently)",
+                    hint="fix the regex, or register a probe model "
+                         "that carries the leaf it targets",
+                    detail=f"{name}:dead:{pat}"))
+        for note, path, n in large:
+            findings.append(Finding(
+                rule="FDT108", severity="error", file=_RULES_SRC, line=0,
+                message=f"rule table {name!r}: {note} leaf {path} "
+                        f"({n} elements) matches no rule and silently "
+                        "falls to replication — at scale that is a "
+                        "full copy on every device",
+                hint="add a rule for it (or a ShardLargest catch-all); "
+                     "sub-threshold leaves replicate by design",
+                detail=f"{name}:unmatched:{path}"))
+    return findings
+
+
 def check_variant(v: StepVariant, execute: Optional[bool] = None) -> List[Finding]:
     out: List[Finding] = []
     out += check_variant_sharding(v)
@@ -330,6 +431,10 @@ def run_jaxpr_checks(
         for v in variants:
             findings.extend(check_variant(v, execute=execute))
         return findings
+    if names is None:
+        # the full sweep also audits the committed rule tables (a
+        # --variants-filtered run stays scoped to those variants)
+        findings.extend(check_rule_tables())
     from .variants import VARIANT_BUILDERS
 
     for name in (names or list(VARIANT_BUILDERS)):

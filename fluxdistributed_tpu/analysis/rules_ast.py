@@ -604,6 +604,31 @@ def _axis_literal_findings(ctx: ModuleContext, rule: AstRule):
                              "cannot drift")
         if isinstance(node, ast.Call):
             d = _dotted(node.func)
+            if d.split(".")[-1] == "ShardLargest":
+                # (e) shape-driven rule values in declarative rule
+                # tables (parallel/rules.py): the axis argument is a
+                # mesh axis name exactly like a P() entry
+                cands = list(node.args[:1]) + [
+                    kw.value for kw in node.keywords if kw.arg == "axis"]
+                for e in cands:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                        if e.value not in axes:
+                            yield _finding(
+                                rule, ctx, e,
+                                f"ShardLargest axis {e.value!r} is not "
+                                "declared in mesh.py — rule resolution "
+                                "rejects it on any real mesh",
+                                detail=f"{fname()}:ShardLargest:{e.value}")
+                        else:
+                            yield _finding(
+                                rule, ctx, e,
+                                f"ShardLargest hardcodes axis "
+                                f"{e.value!r} as a string literal",
+                                detail=f"{fname()}:ShardLargest:{e.value}",
+                                severity="warning",
+                                hint="use the mesh constant "
+                                     "(mesh.FSDP_AXIS / ...) instead "
+                                     "of the literal")
             if d.split(".")[-1] in ("P", "PartitionSpec"):
                 # (a) P()/PartitionSpec() arguments, including tuples
                 for arg in node.args:
@@ -666,9 +691,11 @@ def _axis_literal_findings(ctx: ModuleContext, rule: AstRule):
 
 @ast_rule(
     "FDT105", "axis-literal", "error",
-    "mesh-axis name literals not sourced from mesh.py's declarations: "
-    "an unknown literal fails GSPMD partitioning at compile time; a "
-    "hardcoded copy of a declared axis drifts silently on rename.",
+    "mesh-axis name literals not sourced from mesh.py's declarations — "
+    "in PartitionSpecs, axis-parameter defaults, mesh.shape lookups AND "
+    "declarative rule tables (ShardLargest axis arguments): an unknown "
+    "literal fails GSPMD partitioning at compile time; a hardcoded copy "
+    "of a declared axis drifts silently on rename.",
     "source axis names from fluxdistributed_tpu.mesh constants")
 def _check_axis_literal(ctx: ModuleContext) -> Iterable[Finding]:
     yield from _axis_literal_findings(ctx, _rule_by_id("FDT105"))

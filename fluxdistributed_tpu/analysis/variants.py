@@ -87,7 +87,10 @@ def _prepared(name: str, model, dataset, mesh, source_mod,
     task = prepare_training(
         model, dataset, optim.adam(1e-3), mesh=mesh, batch_size=16,
         cycles=1, donate=True, **kw)
-    batch = _dummy_batch(dataset, None, 16, mesh, 1, seed=0)
+    # the task's batch axes, not a hardcoded one: the 3-D layouts
+    # shard batches over (data, fsdp) jointly
+    batch = _dummy_batch(dataset, None, 16, mesh, 1, seed=0,
+                         axis=task.batch_axes)
     return [StepVariant(
         name=name, fn=task.step_fn, args=(task.state, batch),
         donate_argnums=(0,), mesh=mesh, source=_src(source_mod),
@@ -211,6 +214,51 @@ def _build_pp_zb() -> List[StepVariant]:
     return _prepared("pp_zb", model, ds, mesh, pp_1f1b,
                      spmd="pp_1f1b", num_microbatches=2, topk=(),
                      pipeline_schedule="zb")
+
+
+def _build_layout_dp_fsdp() -> List[StepVariant]:
+    """The rule-derived 2-D layout (dp=2 x fsdp=4) on the image model:
+    the EMPTY rule table + the ShardLargest fsdp overlay shards a conv
+    stack with no per-model spec code — swept so the 3-D mesh step
+    keeps donation/axis/retrace hygiene like the hand-built fsdp
+    variant it generalizes."""
+    from .. import mesh as mesh_lib  # noqa: F401 — axis constants source
+    from ..parallel import layout as layout_mod
+
+    model, ds = _image_setup()
+    lay = layout_mod.resolve_layout("dp_fsdp", 8)
+    return _prepared("layout_dp_fsdp", model, ds, lay.build_mesh(),
+                     layout_mod, execute=True, layout=lay)
+
+
+def _build_layout_fsdp_tp() -> List[StepVariant]:
+    """fsdp=4 x tp=2 on the LM: the committed lm_tp rule table decides
+    the Megatron dims, the overlay ZeRO-shards the leftovers — the 2-D
+    large-model recipe, derived from data instead of
+    hybrid_fsdp_tp_specs' special case."""
+    from ..models.transformer_lm import lm_loss_fn
+    from ..parallel import layout as layout_mod
+
+    model, ds = _lm_setup(depth=1, heads=4)
+    lay = layout_mod.resolve_layout("fsdp_tp", 8)
+    return _prepared("layout_fsdp_tp", model, ds, lay.build_mesh(),
+                     layout_mod, layout=lay,
+                     loss_fn=lm_loss_fn(model), topk=())
+
+
+def _build_layout_dp_fsdp_tp() -> List[StepVariant]:
+    """The full 3-D composition dp=2 x fsdp=2 x tp=2 — one mesh, one
+    rule table, all three parallelism families at once (the
+    arXiv:1810.09868 full-program partitioning thesis, exercised on
+    the real prepare_training path)."""
+    from ..models.transformer_lm import lm_loss_fn
+    from ..parallel import layout as layout_mod
+
+    model, ds = _lm_setup(depth=1, heads=4)
+    lay = layout_mod.resolve_layout("dp_fsdp_tp", 8)
+    return _prepared("layout_dp_fsdp_tp", model, ds, lay.build_mesh(),
+                     layout_mod, layout=lay,
+                     loss_fn=lm_loss_fn(model), topk=())
 
 
 def _build_context() -> List[StepVariant]:
@@ -379,6 +427,9 @@ VARIANT_BUILDERS: Dict[str, Callable[[], List[StepVariant]]] = {
     "zero1_fused": _build_zero1_fused,
     "fsdp": _build_fsdp,
     "tp": _build_tp,
+    "layout_dp_fsdp": _build_layout_dp_fsdp,
+    "layout_fsdp_tp": _build_layout_fsdp_tp,
+    "layout_dp_fsdp_tp": _build_layout_dp_fsdp_tp,
     "pp_1f1b": _build_pp_1f1b,
     "pp_planned": _build_pp_planned,
     "pp_zb": _build_pp_zb,

@@ -129,7 +129,9 @@ class PrefetchLoader:
         start: int = 0,
         retries: int = 2,
     ):
-        n = mesh.shape[axis]
+        from ..sharding import axis_size, batch_entry
+
+        n = axis_size(mesh, axis)
         if batch_size % n:
             raise ValueError(
                 f"global batch {batch_size} not divisible by mesh axis '{axis}' size {n}"
@@ -155,8 +157,8 @@ class PrefetchLoader:
             raise ValueError(f"start must be >= 0, got {start}")
         self.start = start
         self.retries = max(0, retries)
-        self.sharding = NamedSharding(mesh, P(axis))
-        self._chunk_sharding = NamedSharding(mesh, P(None, axis))
+        self.sharding = NamedSharding(mesh, P(batch_entry(axis)))
+        self._chunk_sharding = NamedSharding(mesh, P(None, batch_entry(axis)))
         # observability: queue depth + h2d timing land in the process
         # registry so /metrics can answer "is the input pipeline keeping
         # up"; a tracer (set by train() when span tracing is on) adds

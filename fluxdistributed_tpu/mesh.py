@@ -19,9 +19,17 @@ therefore reduces to
   CPU no-op (test/single_device.jl:121-151).
 
 Axis-name conventions used throughout the framework:
-``data`` (batch/DP), ``model`` (tensor parallel), ``seq`` (sequence/context
+``data`` (batch/DP), ``fsdp`` (ZeRO-style parameter/optimizer sharding
+— batches shard over it jointly with ``data``, parameters shard over it
+alone), ``model`` (tensor parallel), ``seq`` (sequence/context
 parallel), ``pipe`` (pipeline), ``expert`` (MoE).  The reference only has
 DP; the extra axes exist so the same mesh plumbing scales past it.
+
+``make_mesh_3d`` builds the standard large-model 3-D mesh
+``(data, fsdp, model)`` the declarative sharding-rules engine
+(``parallel/rules.py`` + ``parallel/layout.py``) targets: pure dp is
+``(N, 1, 1)``, pure ZeRO-3 is ``(1, N, 1)``, and any mixed layout is a
+size assignment — one mesh recipe instead of one per variant.
 """
 
 from __future__ import annotations
@@ -35,6 +43,7 @@ from jax.sharding import Mesh
 
 __all__ = [
     "DATA_AXIS",
+    "FSDP_AXIS",
     "MODEL_AXIS",
     "SEQ_AXIS",
     "PIPE_AXIS",
@@ -43,10 +52,12 @@ __all__ = [
     "device_count",
     "data_mesh",
     "make_mesh",
+    "make_mesh_3d",
     "force_host_devices",
 ]
 
 DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 PIPE_AXIS = "pipe"
@@ -98,6 +109,27 @@ def make_mesh(axes: Mapping[str, int], devs: Sequence | None = None) -> Mesh:
     else:
         arr = np.array(devs).reshape(shape)
     return Mesh(arr, names)
+
+
+def make_mesh_3d(dp: int = 1, fsdp: int = 1, tp: int = 1,
+                 devs: Sequence | None = None) -> Mesh:
+    """The dp×fsdp×tp 3-D mesh ``(data, fsdp, model)`` — axis order is
+    outermost-first so tensor-parallel groups (the latency-sensitive
+    per-layer collectives) land on the innermost, fastest links of the
+    physical topology.  Size-1 axes are kept (not squeezed): every
+    PartitionSpec a rule table derives names the same three axes
+    whatever the layout, so changing a layout never changes the spec
+    vocabulary, only the sizes.
+
+    ``dp`` replicates parameters (pure data parallelism), ``fsdp``
+    shards parameters + optimizer state ZeRO-style (batches shard over
+    ``data`` AND ``fsdp`` jointly), ``tp`` is the Megatron model axis.
+    """
+    for name, v in (("dp", dp), ("fsdp", fsdp), ("tp", tp)):
+        if v < 1:
+            raise ValueError(f"make_mesh_3d {name}={v} must be >= 1")
+    return make_mesh(
+        {DATA_AXIS: dp, FSDP_AXIS: fsdp, MODEL_AXIS: tp}, devs=devs)
 
 
 def force_host_devices(n: int = 8) -> None:

@@ -84,6 +84,16 @@ def rope(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax.Array
 #: step — hardware-dependent, stubbed behind dtype availability)
 KV_QUANTS = ("none", "int8", "fp8")
 
+#: ``valid_len`` cache sentinel meaning "every write is real" — decode
+#: steps and unpadded prefills (models.generate) run ungated.  The
+#: serving engine sets ``valid_len`` to the REAL token count per padded
+#: prefill/chunk call (a dynamic operand, pure cache DATA), so pad
+#: positions never write into the windowed ring — which is what lets
+#: the ring be sized exactly ``sinks + window``, no ``ring_slack``
+#: over-allocation.  2**30 keeps ``cursor + VALID_UNGATED`` inside
+#: int32 for any reachable cursor.
+VALID_UNGATED = 2 ** 30
+
 
 def _kv_store_dtype(kv_quant: str):
     """The cache leaf dtype for a quant scenario (None = model dtype)."""
@@ -164,17 +174,15 @@ class CausalSelfAttention(nn.Module):
     # (prefill runs through the scalar-index path on a batch-1 model and
     # the engine splices the result into the slot).
     slot_decode: bool = False
-    # extra windowed-ring capacity beyond sinks+window.  The serving
-    # engine prefills prompts RIGHT-PADDED to a shape bucket; pad
-    # positions write into the ring, and with an exactly-sized ring a
-    # pad write can evict an IN-BAND real key (position p is evicted by
-    # position p+ring).  Slack >= the largest pad run makes pad
-    # eviction impossible (p+ring lands beyond every written position);
-    # the engine sets this to its largest INTER-BUCKET GAP (the worst
-    # pad run under smallest-covering-bucket assignment) and masks the
-    # pad entries themselves out at splice time.  Band semantics are
-    # untouched — a larger ring only RETAINS more, and retained
-    # out-of-band keys are mask-excluded anyway.
+    # LEGACY extra windowed-ring capacity beyond sinks+window.  Padded
+    # prefill used to need slack >= the largest pad run so a pad write
+    # could not evict an in-band key; the dynamic ``valid_len`` cache
+    # operand (see VALID_UNGATED) now gates pad positions out of the
+    # ring write entirely, so the serving engine runs with slack 0 and
+    # an exactly-sized ring.  The knob is kept for callers that want a
+    # larger retention ring: band semantics are untouched — a larger
+    # ring only RETAINS more, and retained out-of-band keys are
+    # mask-excluded anyway.
     ring_slack: int = 0
     # paged KV cache (serve/engine.py layout="paged"): instead of one
     # contiguous [B, rows] cache per layer, K/V live in a shared pool of
@@ -344,10 +352,17 @@ class CausalSelfAttention(nn.Module):
             slot_live = self.variable(
                 "cache", "slot_live", lambda: jnp.zeros((b,), jnp.int32))
             slot_pos = None
+            valid_len = None
             if self.window is not None:
                 slot_pos = self.variable(
                     "cache", "slot_pos",
                     lambda: jnp.full((b, r_pad), -1, jnp.int32))
+                # per-row valid-token count for the CURRENT call (see
+                # VALID_UNGATED): padded prefill chunks gate their pad
+                # positions out of the ring write
+                valid_len = self.variable(
+                    "cache", "valid_len",
+                    lambda: jnp.full((b,), VALID_UNGATED, jnp.int32))
             if not is_init:
                 # post-init, t is a CHUNK length (1 for the decode step);
                 # the page count is fixed by the stored table, not by t
@@ -426,11 +441,22 @@ class CausalSelfAttention(nn.Module):
                     # the logical ring spans ALL paged rows: rounding
                     # cache_len up to a block multiple only RETAINS
                     # more, and retained out-of-band keys are
-                    # mask-excluded anyway (the ring_slack argument)
+                    # mask-excluded anyway
                     ring = max(r_pad - self.sinks, 1)
-                    keep = wpos > idx[:, None] + t - 1 - ring
+                    # survival window relative to the last REAL position
+                    # of this call: per row, one past it is idx + veff
+                    # (veff = t when ungated — decode steps, unpadded
+                    # prefills — which reduces to the classic
+                    # newest-ring-of-the-chunk rule).  Gating on veff
+                    # means a padded chunk's pad positions neither write
+                    # nor evict, so the ring needs NO slack beyond
+                    # sinks + window.
+                    veff = jnp.minimum(valid_len.value, t)  # [B]
+                    limit = (idx + veff)[:, None]  # [B, 1]
+                    keep = (wpos > limit - 1 - ring) & (wpos < limit)
                     if self.sinks:
-                        keep |= wpos < self.sinks
+                        # pinned sinks keep too — but never a pad
+                        keep |= (wpos < self.sinks) & (wpos < limit)
                         ring_slot = self.sinks + (wpos - self.sinks) % ring
                         lrow = jnp.where(wpos < self.sinks, wpos, ring_slot)
                     else:
@@ -527,6 +553,7 @@ class CausalSelfAttention(nn.Module):
                 "cache", "cache_index", lambda: jnp.zeros(idx_shape, jnp.int32)
             )
             slot_pos = None
+            valid_len = None
             if self.window is not None:
                 sp_shape = (
                     (b, cache_len) if self.slot_decode else (cache_len,)
@@ -534,6 +561,14 @@ class CausalSelfAttention(nn.Module):
                 slot_pos = self.variable(
                     "cache", "slot_pos",
                     lambda: jnp.full(sp_shape, -1, jnp.int32),
+                )
+                # valid-token count for the CURRENT call (VALID_UNGATED
+                # = every write real).  Shaped like cache_index; read by
+                # the scalar-index prefill path only — slot decode steps
+                # one real token per row by construction.
+                valid_len = self.variable(
+                    "cache", "valid_len",
+                    lambda: jnp.full(idx_shape, VALID_UNGATED, jnp.int32),
                 )
             if not is_init and self.slot_decode:
                 # ONE token per slot, every slot at its own depth.  The
@@ -721,14 +756,20 @@ class CausalSelfAttention(nn.Module):
                     # write layout: position p lives at slot p while
                     # p < sinks (pinned, never evicted), else at
                     # sinks + (p - sinks) % ring.  Only sink positions
-                    # and the chunk's newest `ring` tokens survive a
-                    # read-back, so everything else routes to the
-                    # out-of-range slot and mode="drop" discards it —
-                    # this also keeps the scatter duplicate-free.
+                    # and the call's newest `ring` REAL tokens survive a
+                    # read-back (veff gates padded prefill — see
+                    # VALID_UNGATED: pads neither write nor evict, which
+                    # is what lets the ring be exactly sinks + window),
+                    # so everything else routes to the out-of-range slot
+                    # and mode="drop" discards it — this also keeps the
+                    # scatter duplicate-free.
                     ring = max(total - self.sinks, 1)
-                    keep = wpos > idx + t - 1 - ring
+                    veff = jnp.minimum(valid_len.value, t)
+                    limit = idx + veff  # one past the last REAL position
+                    keep = (wpos > limit - 1 - ring) & (wpos < limit)
                     if self.sinks:
-                        keep |= wpos < self.sinks
+                        # pinned sinks keep too — but never a pad
+                        keep |= (wpos < self.sinks) & (wpos < limit)
                         ring_slot = self.sinks + (wpos - self.sinks) % ring
                         slot = jnp.where(wpos < self.sinks, wpos, ring_slot)
                     else:
@@ -957,8 +998,9 @@ class TransformerLM(nn.Module):
     # cursors so independent requests at different depths share ONE
     # compiled single-token step.  Requires decode=True.
     slot_decode: bool = False
-    # extra windowed-ring KV capacity so bucket-padded prefill cannot
-    # evict in-band keys (see CausalSelfAttention.ring_slack)
+    # LEGACY extra windowed-ring capacity (see CausalSelfAttention
+    # .ring_slack) — the serving engine no longer needs it: the dynamic
+    # valid_len operand gates pad writes out of the exactly-sized ring
     ring_slack: int = 0
     # paged KV cache (serve/engine.py layout="paged"): per-layer K/V in
     # a shared pool of kv_blocks fixed-size blocks, indexed through a
@@ -1179,6 +1221,11 @@ def make_decode_cache(model: TransformerLM, batch: int, total_len: int):
         # paged page_table ("unallocated: reads masked, writes dropped")
         if name in ("slot_pos", "page_table"):
             return jnp.full(s.shape, -1, s.dtype)
+        # valid_len zero would gate EVERY write out — fresh caches run
+        # ungated (decode steps, unpadded prefills); the serving engine
+        # arms the gate per padded prefill call
+        if name == "valid_len":
+            return jnp.full(s.shape, VALID_UNGATED, s.dtype)
         return jnp.zeros(s.shape, s.dtype)
 
     return jax.tree_util.tree_map_with_path(_cache_leaf, spec)

@@ -354,6 +354,40 @@ def variant_report(names: Optional[Sequence[str]] = None,
     return out
 
 
+# -- headroom ranking (shared by bin/fit.py and the layout picker) ----------
+
+def rank_memory(variant_memory: Dict[str, dict],
+                budget: Optional[float]) -> List[dict]:
+    """Headroom ranking rows over ``{name: {"memory": step_memory-dict
+    | None}}``, sorted most-headroom-first; entries whose memory model
+    was unavailable rank LAST with ``fits=None`` — unknown is not
+    "fits".  This is the ONE ranking both ``bin/fit.py`` (over a
+    profile artifact's variants) and ``parallel.layout.pick`` (over
+    candidate layouts) consume, so the two CLIs can never drift on
+    what "fits" means."""
+    rows = []
+    for name, entry in sorted(variant_memory.items()):
+        mem = entry.get("memory") if isinstance(entry, dict) else None
+        row = {"variant": name, "peak_bytes": None,
+               "headroom_bytes": None, "fits": None}
+        if mem:
+            row["peak_bytes"] = int(mem["peak_bytes"])
+            if budget is not None:
+                row["headroom_bytes"] = int(budget - mem["peak_bytes"])
+                row["fits"] = row["headroom_bytes"] >= 0
+        rows.append(row)
+
+    def _key(r):
+        if r["peak_bytes"] is None:
+            return (1, 0.0)  # unknowns last
+        if r["headroom_bytes"] is None:
+            return (0, float(r["peak_bytes"]))  # no budget: smallest first
+        return (0, -float(r["headroom_bytes"]))  # most headroom first
+
+    rows.sort(key=_key)
+    return rows
+
+
 # -- baseline workflow (the lint-baseline idiom for memory) -----------------
 
 def check_memory_baseline(current: Dict[str, dict], baseline: dict,

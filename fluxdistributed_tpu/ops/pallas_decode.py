@@ -13,7 +13,7 @@ engine's cache layouts NATIVELY (ROADMAP Open item 2):
   (no MXU work, data-dependent ``pl.when``), so cost tracks the LIVE
   prefix, not the reserved ``max_len``;
 * **windowed ring + attention sinks** — the ring is already compact
-  (``sinks + window + slack`` rows), so the kernel iterates the ring
+  (``sinks + window`` rows), so the kernel iterates the ring
   blocks directly and recovers causality from the ``slot_pos`` side
   buffer: no gather, no scatter, and no dead full-length cache rows to
   mask (the band mask is over ring slots, not absolute positions);

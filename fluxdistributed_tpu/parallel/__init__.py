@@ -34,6 +34,16 @@ from .pp_1f1b import build_schedule, make_train_step_1f1b, pipeline_grads_1f1b
 from . import pp_plan
 from .pp_plan import PipelinePlan, plan_from_model, plan_from_profile, plan_stages
 from .tp import lm_tp_rules, make_train_step_tp, param_specs, shard_state, vit_tp_rules
+from . import rules
+from .rules import (
+    RULE_TABLES,
+    ShardLargest,
+    match_partition_rules,
+    rules_for_model,
+    with_fsdp,
+)
+from . import layout
+from .layout import Layout, LayoutError, layout_candidates, resolve_layout
 
 __all__ = [
     "multihost",
@@ -86,4 +96,15 @@ __all__ = [
     "router_dispatch_expert_choice",
     "router_dispatch",
     "stack_expert_params",
+    "rules",
+    "RULE_TABLES",
+    "ShardLargest",
+    "match_partition_rules",
+    "rules_for_model",
+    "with_fsdp",
+    "layout",
+    "Layout",
+    "LayoutError",
+    "layout_candidates",
+    "resolve_layout",
 ]

@@ -196,10 +196,14 @@ def make_train_step(
     about the update math; the trainer's guard policy engine fetches it
     once per step to detect non-finite grads/loss and loss spikes.
     """
+    from ..sharding import batch_entry
+
     repl = NamedSharding(mesh, P())
     # axis=None: batch replicated (e.g. a pure 'expert' mesh where the
-    # MoE shard_map does its own token split)
-    shard = NamedSharding(mesh, P(axis) if axis is not None else P())
+    # MoE shard_map does its own token split); a tuple shards the batch
+    # dim over several axes jointly (the 3-D (data, fsdp) layouts)
+    shard = NamedSharding(mesh, P(batch_entry(axis)) if axis is not None
+                          else P())
     state_sh = repl if state_shardings is None else state_shardings
     with_rng = _accepts_rng(loss_fn)
 
@@ -264,7 +268,8 @@ def make_train_step(
 
     if steps_per_call < 1:
         raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
-    chunk_shard = NamedSharding(mesh, P(None, axis) if axis is not None else P())
+    chunk_shard = NamedSharding(
+        mesh, P(None, batch_entry(axis)) if axis is not None else P())
 
     def chunked(state: TrainState, batches):
         return jax.lax.scan(step, state, batches)
@@ -295,11 +300,13 @@ def make_eval_step(
     host-addressable.
     """
     from ..ops import topkaccuracy
+    from ..sharding import batch_entry
 
     repl = NamedSharding(mesh, P())
     # axis=None: batch replicated (e.g. a pure 'expert' mesh where the
-    # MoE shard_map does its own token split)
-    shard = NamedSharding(mesh, P(axis) if axis is not None else P())
+    # MoE shard_map does its own token split); tuples shard jointly
+    shard = NamedSharding(mesh, P(batch_entry(axis)) if axis is not None
+                          else P())
     state_sh = repl if state_shardings is None else state_shardings
 
     def step(state: TrainState, batch):

@@ -2,7 +2,7 @@
 vs a paged block pool.
 
 The engine's original slot math reserved worst-case KV memory per slot
-— ``max_slots × (sinks + window + slack | max_len)`` rows per layer —
+— ``max_slots × (sinks + window | max_len)`` rows per layer —
 so HBM scaled with *capacity*, not *live tokens* (ROADMAP Open item 1).
 This module factors that math into two host-side layout objects:
 
@@ -245,7 +245,7 @@ class PagedLayout:
     reads (``models/transformer_lm.py`` paged branch).
 
     ``rows_per_slot`` is the slot's LOGICAL row span (``max_len`` plain,
-    ``sinks + window + slack`` windowed) — rounded up to whole blocks it
+    ``sinks + window`` windowed) — rounded up to whole blocks it
     becomes ``r_pad = pages_per_slot * block_size``, the per-slot page
     count.  Windowed rings reuse their rows, so a slot can never need
     more than ``pages_per_slot`` blocks no matter how long it decodes.

@@ -24,8 +24,10 @@ Two **cache layouts** (``serve/cache_layout.py``) sit under those
 programs:
 
 * ``layout="dense"`` (default) — the original fixed-slot cache:
-  ``max_slots × (sinks + window + slack | max_len)`` rows per layer,
-  ring-buffer + pinned sinks when windowed.  HBM scales with capacity.
+  ``max_slots × (sinks + window | max_len)`` rows per layer,
+  ring-buffer + pinned sinks when windowed (sized EXACTLY: the dynamic
+  valid-length prefill operand gates pad writes out of the ring, so no
+  slack rows are reserved).  HBM scales with capacity.
 * ``layout="paged"`` — a shared pool of ``kv_blocks`` fixed-size KV
   blocks per layer with per-slot page tables carried as device-side
   int32 *data*, so HBM scales with live tokens and freed blocks return
@@ -65,7 +67,7 @@ DEFAULT_KV_BLOCK_SIZE = 16
 #: cache leaves that carry one row per slot (everything else is a
 #: shared block pool in the paged layout)
 _PER_ROW_LEAVES = ("cache_index", "pos_index", "page_table", "slot_pos",
-                   "slot_live")
+                   "slot_live", "valid_len")
 
 
 def _jit_cache_size(fn) -> int:
@@ -232,33 +234,21 @@ class LMEngine:
         self.model = model
         # decode=True rejects attn_fn by design (the cache path always
         # uses the dense core — the math is identical for gathered
-        # weights); dropout is inference-irrelevant.  ring_slack sizes
-        # the windowed ring so PADDED prefill can never evict an in-band
-        # real key (pad writes land beyond every real position's reach);
-        # the splice/chunk writeback then scrubs the pad entries
-        # themselves.  The slack needed is the largest possible PAD RUN:
-        # bucketed prefill pads by less than the gap to the previous
-        # bucket; chunked prefill pads only the final chunk, by less
-        # than the chunk size.
-        if model.window is not None:
-            if self.buckets:
-                gaps = [self.buckets[0]] + [
-                    b - a for a, b in zip(self.buckets, self.buckets[1:])]
-            else:
-                gaps = []
-            if self.prefill_chunk:
-                gaps.append(self.prefill_chunk)
-            slack = max(gaps)
-        else:
-            slack = 0
-        #: per-slot per-layer KV rows logically addressable.  For
-        #: windowed models this is sinks+window+slack (slack = largest
-        #: pad run), NOT sinks+window: sparse buckets inflate it.  Pass
-        #: a denser bucket ladder (or a smaller prefill chunk) to
-        #: tighten the bound toward the window.
+        # weights); dropout is inference-irrelevant.  Padded prefill is
+        # made safe by the DYNAMIC VALID-LENGTH operand: every prefill/
+        # chunk program receives the call's real token count as cache
+        # data (``valid_len`` — see models.transformer_lm.VALID_UNGATED)
+        # and the model gates pad positions out of the windowed ring
+        # write, so a pad can never write OR evict an in-band key.  The
+        # ring is therefore sized exactly sinks + window — the old
+        # ``ring_slack`` over-allocation (largest pad run: inter-bucket
+        # gap / prefill chunk) is gone, and the reclaimed rows show up
+        # directly in ``reserved_kv_bytes``.
+        #: per-slot per-layer KV rows logically addressable:
+        #: sinks + window for windowed models (exact), max_len otherwise
         self.kv_rows_per_slot = (
             max_len if model.window is None
-            else min(model.window + model.sinks + slack, max_len))
+            else min(model.window + model.sinks, max_len))
         if layout == "paged":
             pages_per_slot = -(-self.kv_rows_per_slot // kv_block_size)
             if kv_blocks is None:
@@ -271,9 +261,14 @@ class LMEngine:
             self.layout = DenseLayout(max_slots, self.kv_rows_per_slot,
                                       kv_quant=kv_quant)
             paged_kw = dict()
+        # ring_slack pinned to 0 on the clones: the engine's layout
+        # math (kv_rows_per_slot, pages_per_slot, reserved_kv_bytes)
+        # sizes the ring at exactly sinks + window — a user model's
+        # retention slack must not silently desynchronize the cache
+        # allocation from that accounting
         self.decode_model = model.clone(
             decode=True, slot_decode=True, attn_fn=None, dropout=0.0,
-            ring_slack=slack, attention_impl=attention_impl,
+            ring_slack=0, attention_impl=attention_impl,
             kv_quant=kv_quant, **paged_kw)
         self.cache = make_decode_cache(self.decode_model, max_slots, max_len)
         if layout == "dense":
@@ -283,7 +278,7 @@ class LMEngine:
             # fills is the cache the splice hands to the decode step
             self.prefill_model = model.clone(
                 decode=True, slot_decode=False, attn_fn=None, dropout=0.0,
-                ring_slack=slack, attention_impl=attention_impl,
+                ring_slack=0, attention_impl=attention_impl,
                 kv_quant=kv_quant)
             # reusable zero template: _prefill never mutates its input,
             # so one template serves every admission
@@ -330,7 +325,20 @@ class LMEngine:
     def _prefill_impl(self, params, cache0, toks, plen):
         """Whole padded prompt (or one chunk of it) in one parallel
         pass; returns the filled batch-1 cache and the logits at the
-        LAST REAL position (the distribution of the next token)."""
+        LAST REAL position (the distribution of the next token).
+
+        ``plen`` — the call's REAL token count — is also the dynamic
+        valid-length operand: it arms the windowed ``valid_len`` write
+        gate (cache DATA, so every prompt length shares ONE compiled
+        program per bucket) so pad positions never write into, or
+        evict from, the exactly-sized ring."""
+        if self.model.window is not None:
+            def arm(path, leaf):
+                if _leaf_name(path) == "valid_len":
+                    return jnp.full_like(leaf, plen)
+                return leaf
+
+            cache0 = jax.tree_util.tree_map_with_path(arm, cache0)
         logits, mut = self.prefill_model.apply(
             {"params": params, "cache": cache0}, toks, train=False,
             mutable=["cache"],
@@ -357,6 +365,10 @@ class LMEngine:
                 # holds exactly what a batch-1 unpadded prefill of plen
                 # tokens would hold — the parity invariant
                 return bg.at[slot].set(jnp.where(sm < plen, sm, -1))
+            if name == "valid_len":
+                # decode rows run UNGATED (every decode write is real);
+                # the gate is a per-prefill-call operand, not slot state
+                return bg
             if name in ("cached_k", "cached_v",
                         "cached_k_scale", "cached_v_scale"):
                 return bg.at[slot].set(sm[0])
@@ -389,6 +401,11 @@ class LMEngine:
                     row = jnp.full_like(row, start)
                 if name == "slot_live":
                     row = jnp.ones_like(row)  # the chunk itself writes
+                if name == "valid_len":
+                    # the dynamic valid-length operand: only nvalid of
+                    # this chunk's positions are real — the windowed
+                    # write gate drops the pads (no ring slack needed)
+                    row = jnp.full_like(row, nvalid)
                 if name == "slot_pos":
                     # every ring entry >= start is cursor-drift garbage
                     # from before the slot_live gate existed for this
@@ -414,6 +431,8 @@ class LMEngine:
                 return big.at[slot].set(jnp.asarray(end, big.dtype))
             if name == "slot_live":
                 return big.at[slot].set(arm.astype(big.dtype))
+            if name == "valid_len":
+                return big  # decode rows stay ungated (VALID_UNGATED)
             if name == "slot_pos":
                 return big.at[slot].set(
                     jnp.where(small[0] < end, small[0], -1))

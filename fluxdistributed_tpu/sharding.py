@@ -15,7 +15,6 @@ mesh plumbing is shared.
 
 from __future__ import annotations
 
-import re
 from typing import Any, Sequence
 
 import jax
@@ -29,6 +28,8 @@ Pytree = Any
 __all__ = [
     "P",
     "replicated",
+    "axis_size",
+    "batch_entry",
     "batch_spec",
     "replicate",
     "shard_batch",
@@ -44,9 +45,29 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def batch_spec(axis: str = mesh_lib.DATA_AXIS) -> P:
-    """PartitionSpec sharding the leading (batch) dimension."""
-    return P(axis)
+def batch_entry(axis):
+    """One PartitionSpec DIM entry for the batch dimension: the axis
+    name, or a tuple of names when the batch shards over several mesh
+    axes jointly (the 3-D ``(data, fsdp)`` layouts — ``P(("data",
+    "fsdp"))`` splits dim 0 over both communicators)."""
+    return axis if isinstance(axis, str) else tuple(axis)
+
+
+def axis_size(mesh: Mesh, axis) -> int:
+    """Extent of one axis — or the PRODUCT over a tuple of axes (the
+    shard count a multi-axis batch dim splits into)."""
+    if isinstance(axis, str):
+        return int(mesh.shape[axis])
+    size = 1
+    for a in axis:
+        size *= int(mesh.shape[a])
+    return size
+
+
+def batch_spec(axis=mesh_lib.DATA_AXIS) -> P:
+    """PartitionSpec sharding the leading (batch) dimension (``axis``
+    may be one mesh axis name or a tuple sharded jointly)."""
+    return P(batch_entry(axis))
 
 
 def unaliased(x):
@@ -122,8 +143,10 @@ def stack_on_axis(per_item: Sequence[Pytree], mesh: Mesh, axis: str) -> Pytree:
     return jax.tree.map(lambda x: jax.device_put(x, sh), stacked)
 
 
-def shard_batch(batch: Pytree, mesh: Mesh, axis: str = mesh_lib.DATA_AXIS) -> Pytree:
-    """Shard every array's leading dim across ``axis`` of the mesh.
+def shard_batch(batch: Pytree, mesh: Mesh, axis=mesh_lib.DATA_AXIS) -> Pytree:
+    """Shard every array's leading dim across ``axis`` of the mesh
+    (one axis name, or a tuple sharded jointly — the 3-D layouts'
+    ``("data", "fsdp")`` batch).
 
     Analog of the reference partitioning the sample table into per-device
     shards (src/ddp_tasks.jl:257-258) + the per-device ``gpu(shard)``
@@ -136,12 +159,12 @@ def shard_batch(batch: Pytree, mesh: Mesh, axis: str = mesh_lib.DATA_AXIS) -> Py
     """
     from .parallel.multihost import global_batch_put, local_batch_size
 
-    s = NamedSharding(mesh, P(axis))
+    s = NamedSharding(mesh, batch_spec(axis))
     pi = jax.process_index()
 
     def put(x):
         x = np.asarray(x) if not isinstance(x, jax.Array) else x
-        n = mesh.shape[axis]
+        n = axis_size(mesh, axis)
         if x.shape[0] % n != 0:
             raise ValueError(
                 f"batch dim {x.shape[0]} not divisible by mesh axis '{axis}' size {n}"
@@ -155,27 +178,15 @@ def shard_batch(batch: Pytree, mesh: Mesh, axis: str = mesh_lib.DATA_AXIS) -> Py
 def partition_by_rules(rules: Sequence[tuple[str, P]], params: Pytree) -> Pytree:
     """Pytree of PartitionSpecs chosen by regex match on the leaf path.
 
-    Scalars and unmatched leaves are replicated (``P()``).  Used for
-    tensor-parallel / FSDP parameter layouts; data-parallel models just
-    use ``replicated``.
+    Scalars and unmatched leaves are replicated (``P()``).  Thin alias
+    over the declarative rules engine's matcher
+    (:func:`~.parallel.rules.match_partition_rules` — ONE matching
+    implementation; pass ``mesh=``/``strict=``/``report=`` there for
+    validation, ShardLargest values and fallback reporting).
     """
-    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-    specs = []
-    for path, leaf in flat:
-        name = "/".join(
-            getattr(k, "key", getattr(k, "name", str(getattr(k, "idx", k))))
-            for k in path
-        )
-        if np.ndim(leaf) == 0 or int(np.prod(np.shape(leaf))) == 1:
-            specs.append(P())
-            continue
-        for pat, spec in rules:
-            if re.search(pat, name):
-                specs.append(spec)
-                break
-        else:
-            specs.append(P())
-    return jax.tree_util.tree_unflatten(treedef, specs)
+    from .parallel.rules import match_partition_rules
+
+    return match_partition_rules(list(rules), params)
 
 
 def make_shardings(spec_tree: Pytree, mesh: Mesh) -> Pytree:

@@ -91,6 +91,10 @@ class TrainTask:
     # by default so a mode that compiles loss-only eval (the LM
     # pipelines) needs no caller-side coordination
     topk: tuple = (1, 5, 10)
+    # the mesh axes the batch dim shards over — one name for the
+    # classic modes, ("data", "fsdp") for the rule-derived 3-D layouts
+    # (evaluate/shard paths must split batches over BOTH communicators)
+    batch_axes: Any = mesh_lib.DATA_AXIS
 
 
 def _eval_view(dataset):
@@ -129,6 +133,7 @@ def prepare_training(
     input_shape: Optional[Sequence[int]] = None,
     spmd: str = "jit",
     zero1: bool = False,
+    layout=None,
     donate: bool = False,
     topk: Sequence[int] = (1, 5, 10),
     accum_steps: int = 1,
@@ -258,6 +263,33 @@ def prepare_training(
 
     if spmd == "dp":  # explicit-name alias for the auto-sharded DP path
         spmd = "jit"
+    if layout is not None:
+        # the declarative path (parallel/rules.py + parallel/layout.py):
+        # a dp×fsdp×tp Layout (or preset name) whose rule-derived spec
+        # tree drives the UNCHANGED dp step — it subsumes the modes it
+        # composes, so combining it with one of them is a contradiction
+        from ..parallel import layout as layout_lib
+
+        if spmd != "jit":
+            raise ValueError(
+                f"layout= builds the rule-derived 3-D step and cannot "
+                f"combine with spmd={spmd!r} (keep the default "
+                "spmd='jit'/'dp')")
+        if zero1:
+            raise ValueError(
+                "layout= cannot combine with zero1=True: a layout's "
+                "fsdp axis already shards the optimizer state "
+                "(ZeRO-3 placement subsumes ZeRO-1) — use e.g. "
+                "layout='fsdp' or 'dp_fsdp'")
+        if steps_per_call != 1:
+            raise ValueError("steps_per_call > 1 is not supported with "
+                             "layout= (yet) — drop one of them")
+        # a caller-supplied mesh defines the topology (it may span a
+        # device SUBSET — build_mesh(devs=...) is supported surface);
+        # validate_mesh below still pins the axis sizes exactly
+        layout = layout_lib.resolve_layout(
+            layout,
+            ndev=int(mesh.devices.size) if mesh is not None else None)
     if steps_per_call < 1:
         raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
     if steps_per_call != 1 and spmd != "jit":
@@ -309,7 +341,11 @@ def prepare_training(
             "pp_plan cannot combine with pipeline_interleave: planner "
             "boundaries are contiguous block ranges, the interleaved "
             "placement is round-robin")
-    mesh = mesh or mesh_lib.data_mesh()
+    if layout is not None:
+        mesh = mesh or layout.build_mesh()
+        layout.validate_mesh(mesh)
+    else:
+        mesh = mesh or mesh_lib.data_mesh()
     init_draw = None
     # a data-axis-divisible init sample for the modes whose models
     # contain a mesh-bound shard_map (ring attention, MoE dispatch) —
@@ -339,7 +375,40 @@ def prepare_training(
     if loss_fn is None:
         loss_fn = flax_loss_fn(model, loss)
     batch_quantum = 0  # pipeline modes raise it to data_size x microbatches
-    if spmd in ("tp", "fsdp_tp"):
+    batch_axes = mesh_lib.DATA_AXIS  # layouts widen it to (data, fsdp)
+    if layout is not None:
+        # declarative rule-derived sharding (ROADMAP item 3): the model
+        # family's committed rule table + the fsdp overlay produce the
+        # spec tree; the step itself is the UNCHANGED dp step compiled
+        # with those shardings and the batch split over (data, fsdp) —
+        # GSPMD derives the dp/ZeRO-3/Megatron collective composition
+        # from the annotations, same as the hand-built fsdp/tp variants
+        from ..parallel import layout as layout_lib
+        from ..sharding import make_shardings, unaliased
+
+        state = TrainState.create(params, optimizer, model_state=model_state)
+        spec_state = layout_lib.state_specs_for(model, state, layout, mesh)
+        sh = make_shardings(spec_state, mesh)
+
+        def _put(x, s):
+            return None if x is None else jax.device_put(unaliased(x), s)
+
+        state = jax.tree.map(_put, state, sh, is_leaf=lambda x: x is None)
+        batch_axes = layout.batch_axes
+        if batch_size % layout.batch_shards:
+            raise ValueError(
+                f"batch_size {batch_size} must be divisible by the "
+                f"layout's dp x fsdp = {layout.batch_shards} "
+                f"({layout.describe()})")
+        batch_quantum = layout.batch_shards
+        step_fn = make_train_step(
+            loss_fn, optimizer, mesh, axis=batch_axes,
+            donate=donate, accum_steps=accum_steps, seed=seed,
+            state_shardings=sh, guard=guard)
+        eval_fn = make_eval_step(
+            loss_fn, mesh, axis=batch_axes, topk=tuple(topk),
+            state_shardings=sh)
+    elif spmd in ("tp", "fsdp_tp"):
         # Megatron tensor parallelism over a (data, model) mesh; sharding
         # rules picked by model family ("fsdp_tp" additionally
         # FSDP-shards each large leaf's leftover dim over the data axis —
@@ -648,6 +717,7 @@ def prepare_training(
         epochs=epochs,
         buffersize=buffersize,
         seed=seed,
+        axis=batch_axes,
         transform=transform,
         chunk=steps_per_call,
     )
@@ -668,7 +738,8 @@ def prepare_training(
         from ..data.loader import batch_to_dict
 
         val_batch = sharding_lib.shard_batch(
-            batch_to_dict(vdraw, getattr(val_dataset, "nclasses", None)), mesh
+            batch_to_dict(vdraw, getattr(val_dataset, "nclasses", None)),
+            mesh, axis=batch_axes,
         )
 
     task = TrainTask(
@@ -684,13 +755,15 @@ def prepare_training(
         steps_per_call=steps_per_call,
         batch_quantum=batch_quantum,
         topk=tuple(topk),
+        batch_axes=batch_axes,
     )
 
     if aot or warmup:
         from .. import compilation
 
         dummy = _dummy_batch(
-            dataset, transform, batch_size, mesh, steps_per_call, seed)
+            dataset, transform, batch_size, mesh, steps_per_call, seed,
+            axis=batch_axes)
         if aot:
             # the tag covers everything that changes the compiled
             # program WITHOUT changing argument shapes: mode/schedule
@@ -717,6 +790,11 @@ def prepare_training(
                 num_microbatches, pipeline_interleave, repr(model),
                 optimizer.name, optimizer.update, loss_fn, loss,
                 *(("guard",) if guard else ()),
+                # a layout changes the compiled program (shardings) at
+                # identical shapes; appended only when set so every
+                # pre-existing run keeps its tag byte-for-byte
+                *((f"layout:{layout.name}:{sorted(layout.sizes.items())}",)
+                  if layout is not None else ()),
                 *((pipeline_schedule,) if pipeline_schedule != "1f1b"
                   else ()),
                 # a UNIFORM plan builds the no-plan program exactly, so
@@ -837,7 +915,8 @@ def _strict_first_call(fn, phase: str):
     return wrapped
 
 
-def _dummy_batch(dataset, transform, batch_size, mesh, steps_per_call, seed):
+def _dummy_batch(dataset, transform, batch_size, mesh, steps_per_call, seed,
+                 axis=mesh_lib.DATA_AXIS):
     """One batch with training's exact layout (transform applied,
     device-sharded, stacked when the device loop is on) for AOT
     lowering and warmup — drawn from the dataset so shapes AND dtypes
@@ -866,7 +945,7 @@ def _dummy_batch(dataset, transform, batch_size, mesh, steps_per_call, seed):
                 np.stack([local] * steps_per_call), s, batch_dim=1)
 
         return {k: put(v) for k, v in bd.items()}
-    return sharding_lib.shard_batch(bd, mesh)
+    return sharding_lib.shard_batch(bd, mesh, axis=axis)
 
 
 def restore_training(
@@ -1077,7 +1156,8 @@ def evaluate(
         nonlocal n
         draw = apply_transform(task.transform, draw)
         batch = sharding_lib.shard_batch(
-            batch_to_dict(draw, getattr(dataset, "nclasses", None)), task.mesh
+            batch_to_dict(draw, getattr(dataset, "nclasses", None)), task.mesh,
+            axis=getattr(task, "batch_axes", mesh_lib.DATA_AXIS),
         )
         loss, accs = task.eval_fn(task.state, batch)
         if first:

@@ -20,3 +20,8 @@ def free_string():
     # a string equal to no declared axis, outside P()/axis positions —
     # out of the rule's scope entirely
     return "datalog"
+
+
+def good_rule_table(ShardLargest, FSDP_AXIS):
+    # rule-table values sourced from the mesh constants
+    return [(r".*", ShardLargest(axis=FSDP_AXIS))]

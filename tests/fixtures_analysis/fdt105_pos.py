@@ -19,3 +19,13 @@ PIPE_AXIS = "pipe"  # re-declares mesh.py's literal
 
 def stage_count(mesh):
     return mesh.shape["pipe"]  # literal mesh-shape lookup
+
+
+def bogus_rule_table(ShardLargest):
+    # rule-table value naming an undeclared axis: resolution rejects it
+    return [(r".*", ShardLargest("nonexistent_axis"))]
+
+
+def hardcoded_rule_table(ShardLargest):
+    # declared axis, but a drifting string copy
+    return [(r".*", ShardLargest(axis="fsdp"))]

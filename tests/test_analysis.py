@@ -105,8 +105,8 @@ def test_declared_mesh_axes_match_mesh_module():
     from fluxdistributed_tpu import mesh as mesh_lib
 
     assert rules_ast.declared_mesh_axes() == {
-        mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS, mesh_lib.SEQ_AXIS,
-        mesh_lib.PIPE_AXIS, mesh_lib.EXPERT_AXIS}
+        mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS, mesh_lib.MODEL_AXIS,
+        mesh_lib.SEQ_AXIS, mesh_lib.PIPE_AXIS, mesh_lib.EXPERT_AXIS}
 
 
 # ------------------------------------------------------- findings/baseline
@@ -188,6 +188,76 @@ def test_spec_rank_overflow_and_clean(mesh8):
     assert jaxpr_checks.check_spec_tree(
         {"w": (16, 4), "b": (4,)},
         {"w": P(DATA_AXIS, None), "b": None}, mesh8, where="toy") == []
+
+
+def test_fdt108_committed_tables_clean():
+    """The committed rule tables (parallel/rules.py RULE_TABLES) carry
+    no dead rules and no silently-replicating large leaves on their
+    registered probe models — the baseline-stays-EMPTY contract
+    extended to declarative sharding data."""
+    assert jaxpr_checks.check_rule_tables() == []
+
+
+def test_fdt108_dead_rule_and_large_unmatched():
+    import numpy as np
+
+    from fluxdistributed_tpu.parallel.rules import RuleTable
+
+    def probe():
+        # one large leaf (embedding-sized) + one small one
+        return ({"embed": {"table": np.zeros((1024, 8), np.float32)},
+                 "norm": {"scale": np.zeros((8,), np.float32)}},
+                "toy-probe")
+
+    def bad_table():
+        from jax.sharding import PartitionSpec as P
+
+        from fluxdistributed_tpu.mesh import DATA_AXIS
+
+        # typo'd path: matches nothing; nothing covers the big leaf
+        return [(r"embedd/tabel$", P(DATA_AXIS, None))]
+
+    tables = {"toy": RuleTable("toy", bad_table, probes=(probe,))}
+    fs = jaxpr_checks.check_rule_tables(tables)
+    assert sorted(f.detail for f in fs) == [
+        "toy:dead:embedd/tabel$", "toy:unmatched:embed/table"]
+    assert all(f.rule == "FDT108" for f in fs)
+    assert "dead rule" in fs[0].message or "dead rule" in fs[1].message
+    # the small leaf replicates by design — never a finding
+    assert not any("norm/scale" in f.detail for f in fs)
+    # a table that opts out of the unmatched check (dp/fsdp semantics)
+    # only reports the dead rule
+    tables = {"toy": RuleTable("toy", bad_table, probes=(probe,),
+                               check_unmatched=False)}
+    assert [f.detail for f in jaxpr_checks.check_rule_tables(tables)] \
+        == ["toy:dead:embedd/tabel$"]
+
+
+def test_fdt108_duplicate_pattern_flagged():
+    """A duplicated pattern is unreachable under first-match-wins (and
+    would collapse in the aliveness dict) — flagged outright, not
+    silently reported alive."""
+    import numpy as np
+
+    from fluxdistributed_tpu.parallel.rules import RuleTable
+
+    def probe():
+        return ({"qkv": {"kernel": np.zeros((8, 8), np.float32)}},
+                "toy-probe")
+
+    def dup_table():
+        from jax.sharding import PartitionSpec as P
+
+        from fluxdistributed_tpu.mesh import DATA_AXIS, MODEL_AXIS
+
+        return [(r"qkv/kernel$", P(DATA_AXIS, None)),
+                (r"qkv/kernel$", P(None, MODEL_AXIS))]  # unreachable
+
+    tables = {"toy": RuleTable("toy", dup_table, probes=(probe,),
+                               check_unmatched=False)}
+    fs = jaxpr_checks.check_rule_tables(tables)
+    assert [f.detail for f in fs] == ["toy:duplicate:qkv/kernel$"]
+    assert "unreachable" in fs[0].message
 
 
 def test_donation_dropped(mesh8):

@@ -26,11 +26,12 @@ def test_hw_session_skips_every_stage_past_deadline(tmp_path):
     assert p.returncode == 0, p.stderr[-500:]
     assert time.monotonic() - t0 < 30, "skip path must not launch anything slow"
     log = (tmp_path / "session.log").read_text()
-    # all 22 stage launches declined (incl. the 4 flash-vs-blockwise LM
+    # all 23 stage launches declined (incl. the 4 flash-vs-blockwise LM
     # rows, the windowed/GQA rows, the 3 serving decode rows, the
-    # flash-decode kernel row, and the pipeline planner/zero-bubble
-    # row); the chain still runs to completion
-    assert log.count("skipping next stage") == 22, log
+    # flash-decode kernel row, the pipeline planner/zero-bubble row,
+    # and the auto-layout picker row); the chain still runs to
+    # completion
+    assert log.count("skipping next stage") == 23, log
     assert "session complete" in log
     # nothing produced measurement output
     assert not (tmp_path / "bench.jsonl").exists()

@@ -130,6 +130,33 @@ def test_tp_hlo_axes_attribution(variants):
                    ("all_reduce", ("data",)): 17}
 
 
+def test_layout_hlo_signatures():
+    """The rule-derived 3-D layouts' compiled signatures, pinned like
+    dp/zero1: the 2-D dp x fsdp image layout moves its gradient mean
+    over the JOINT (data, fsdp) communicator (the batch shards over
+    both, so the mean is one all-reduce spanning both axes); the
+    tp-composed LM layouts split activation reductions onto the model
+    axis next to the batch-communicator gradient mean — byte-identical
+    structure to the hand-built tp variant's (17 data + 10 model) with
+    the batch communicator renamed to the layout's axes.  The
+    replica_groups matcher must untangle the multi-axis groups of the
+    3-D mesh, including the joint (data, fsdp) combination."""
+    cases = {
+        "layout_dp_fsdp": {("all_reduce", ("data", "fsdp")): 7},
+        "layout_fsdp_tp": {("all_reduce", ("fsdp",)): 17,
+                           ("all_reduce", ("model",)): 10},
+        "layout_dp_fsdp_tp": {("all_reduce", ("data", "fsdp")): 17,
+                              ("all_reduce", ("model",)): 10},
+    }
+    for name, want in cases.items():
+        (v,) = build_variants([name])
+        # GSPMD variant: the jaxpr carries no collectives, the
+        # compiled HLO carries the derived schedule
+        assert jaxpr_collectives(v.fn, v.args) == []
+        compiled = v.fn.lower(*v.args).compile()
+        assert _by_key(hlo_collectives(compiled, mesh=v.mesh)) == want, name
+
+
 def test_fsdp_hlo_signature(variants):
     """fsdp's compiled signature pinned as XLA emits it HERE: on this
     CPU build the tiny model's gather/scatter pairs fold into plain

@@ -75,6 +75,78 @@ def test_parity_interleaved_admissions(config):
         assert r.tokens == _ref(model, params, p, 9), (config, p)
 
 
+def test_windowed_ring_exact_no_slack():
+    """The dynamic valid-length prefill operand drops the ring_slack
+    over-allocation: a windowed engine's per-slot KV rows are EXACTLY
+    sinks + window — with a bucket ladder whose pad runs dwarf the
+    window (the configuration that, pre-gate, needed slack >= the
+    largest inter-bucket gap to avoid pad eviction) — and golden token
+    parity still holds, at ONE prefill compile per bucket.  The
+    reclaimed bytes surface through reserved_kv_bytes: reserved ==
+    predicted == rows x (sinks + window) x per-row bytes."""
+    model, params = _make("window_sinks")  # window=8, sinks=2
+    # buckets (4, 32): a 5-token prompt pads by 27 — over 3x the window
+    engine = LMEngine(model, params, max_slots=2, max_len=32,
+                      buckets=(4, 32))
+    assert engine.kv_rows_per_slot == 8 + 2
+    kv = engine.kv_cache_bytes()
+    assert kv["reserved"] == kv["predicted"]
+    sched = Scheduler(engine, max_queue=8)
+    rng = np.random.default_rng(3)
+    prompts = [list(rng.integers(0, 32, n)) for n in (5, 3, 12)]
+    reqs = [Request(prompt=p, max_new_tokens=8) for p in prompts]
+    sched.submit(reqs[0]); sched.submit(reqs[1])
+    sched.step()
+    sched.submit(reqs[2])
+    sched.run_until_idle()
+    for r, p in zip(reqs, prompts):
+        assert r.tokens == _ref(model, params, p, 8), p
+    stats = engine.compile_stats()
+    assert stats["decode_compiles"] in (-1, 1)
+    assert stats["prefill_compiles"] in (-1, 2)  # one per bucket
+
+
+def test_engine_pins_user_ring_slack_to_zero():
+    """A user model carrying ring_slack>0 must not desynchronize the
+    engine's exact sinks+window accounting: the clones pin slack to 0,
+    so reserved==predicted holds and parity is unchanged."""
+    model, params = _make("window_sinks", ring_slack=4)
+    engine = LMEngine(model, params, max_slots=2, max_len=32,
+                      buckets=(8, 32))
+    assert engine.kv_rows_per_slot == 8 + 2
+    assert engine.decode_model.ring_slack == 0
+    kv = engine.kv_cache_bytes()
+    assert kv["reserved"] == kv["predicted"]
+    sched = Scheduler(engine, max_queue=4)
+    p = list(np.random.default_rng(9).integers(0, 32, 6))
+    r = Request(prompt=p, max_new_tokens=6)
+    sched.submit(r)
+    sched.run_until_idle()
+    # the reference clone carries the user's slack (a larger retention
+    # ring never changes band semantics) — parity must hold across it
+    assert r.tokens == _ref(model, params, p, 6)
+
+
+def test_windowed_chunked_prefill_exact_ring():
+    """Dense CHUNKED prefill (prefill_chunk smaller than the window's
+    pad runs) through the exactly-sized ring: each chunk's valid length
+    rides the same dynamic operand, so a padded final chunk cannot
+    evict in-band keys."""
+    model, params = _make("window_sinks")
+    engine = LMEngine(model, params, max_slots=2, max_len=32,
+                      buckets=(32,), prefill_chunk=8)
+    assert engine.kv_rows_per_slot == 8 + 2
+    sched = Scheduler(engine, max_queue=8)
+    rng = np.random.default_rng(5)
+    prompts = [list(rng.integers(0, 32, n)) for n in (13, 9)]
+    reqs = [Request(prompt=p, max_new_tokens=6) for p in prompts]
+    for r in reqs:
+        sched.submit(r)
+    sched.run_until_idle()
+    for r, p in zip(reqs, prompts):
+        assert r.tokens == _ref(model, params, p, 6), p
+
+
 def test_parity_learned_positions():
     """use_rope=False (the GPT-2 interop layout) decodes through per-slot
     pos_index cursors with the same parity guarantee."""
