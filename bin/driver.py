@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import argparse
 import sys
+import time
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -211,6 +212,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="declare XLA warmup over after N cycles: any later "
                         "compile is counted + warned as a steady-state "
                         "recompile (fdtpu_jax_steady_recompiles_total)")
+    p.add_argument("--flight", default=None, metavar="PATH",
+                   help="black-box flight recorder (obs.flight): append "
+                        "per-step records (step, loss, guard verdict, "
+                        "phase seconds, headroom, compiles) here, flushed "
+                        "+ checkpointed every few records — a SIGKILL "
+                        "loses at most one flush interval, and the dump "
+                        "footer (or its absence) says how the run ended")
+    p.add_argument("--runs-ledger", default=None, metavar="PATH",
+                   help="append one cross-run ledger record (obs.runs "
+                        "schema: status, topology fingerprint, steps, "
+                        "compile seconds, flight-dump path) here on every "
+                        "exit path — the history bin/trends.py gates "
+                        "regressions against")
     # cold-start performance (fluxdistributed_tpu.compilation)
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="enable JAX's persistent compilation cache here "
@@ -749,6 +763,7 @@ def main(argv=None) -> int:
         steady_after=args.steady_after,
         jsonl_path=args.metrics_jsonl,
         profile_path=args.profile_out,
+        flight_path=args.flight,
     )
     metrics_srv = None
     if args.metrics_port is not None and multihost.is_coordinator():
@@ -784,6 +799,55 @@ def main(argv=None) -> int:
 
     from fluxdistributed_tpu.train import GuardHalt
 
+    t_train = time.monotonic()
+
+    def _ledger(status, error=None, retryable=None, live=False):
+        """Append this run's row to the cross-run ledger.  Best-effort
+        on every exit path — the ledger must never change an exit code.
+        The topology fingerprint calls ``jax.devices()``, which can
+        HANG on a wedged backend, so it is only computed when ``live``
+        says the backend provably just answered (done/halt/preempt —
+        never on the crash path)."""
+        if not args.runs_ledger:
+            return
+        try:
+            from fluxdistributed_tpu.compilation import (
+                topology_fingerprint,
+            )
+            from fluxdistributed_tpu.obs import get_registry
+            from fluxdistributed_tpu.obs import runs as runs_lib
+
+            reg = get_registry()
+            fp = None
+            if live:
+                try:
+                    fp = topology_fingerprint(mesh)
+                except Exception:  # noqa: BLE001
+                    fp = None
+            wall = max(time.monotonic() - t_train, 1e-9)
+            steps = reg.value("fdtpu_train_steps_total")
+            runs_lib.append_run(args.runs_ledger, runs_lib.run_record(
+                "train",
+                fingerprint=fp,
+                phase="train",
+                retryable=retryable,
+                error=error,
+                metrics={
+                    "steps": steps,
+                    "steps_per_sec": steps / wall,
+                    "wall_seconds": wall,
+                    "compile_seconds": reg.value(
+                        "fdtpu_jax_compile_seconds_total"),
+                    "oom_skipped": reg.value(
+                        "fdtpu_train_oom_skipped_total"),
+                },
+                flight=args.flight,
+                status=status,
+            ))
+        except Exception as e:  # noqa: BLE001
+            print(f"runs ledger append failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
     try:
         train(
             task,
@@ -801,6 +865,7 @@ def main(argv=None) -> int:
     except GuardHalt as e:
         # recovery is looping: a DISTINCT, deliberately NON-retryable
         # exit code — a supervisor must page a human, not requeue
+        _ledger("halted", error=str(e), retryable=False, live=True)
         if multihost.is_coordinator():
             print(f"guard halt: {e} (exit code {faults.HALTED_RC}, "
                   "retryable: false)")
@@ -809,14 +874,22 @@ def main(argv=None) -> int:
         # checkpoint + RESUME manifest are already durably on disk;
         # the DISTINCT exit code tells a supervisor "requeue me with
         # --resume", unlike 0 (done) or 1 (crashed)
+        _ledger("preempted", error=str(e), retryable=True, live=True)
         if multihost.is_coordinator():
             print(f"preempted: {e} — resume with --resume "
                   f"--checkpoint-dir {args.checkpoint_dir} "
                   f"(exit code {faults.PREEMPTED_RC})")
         return faults.PREEMPTED_RC
+    except BaseException as e:
+        # a crash record with NO fingerprint (the backend may be the
+        # thing that died — fingerprinting it could hang the exit)
+        _ledger("crashed", error=f"{type(e).__name__}: {e}",
+                retryable=None, live=False)
+        raise
     finally:
         if metrics_srv is not None:
             metrics_srv.stop()
+    _ledger("done", live=True)
     multihost.sync_global_devices("train_done")
     if args.final_eval:
         from fluxdistributed_tpu.train import evaluate

@@ -134,6 +134,7 @@ class Supervisor:
         keep_fault_plan: bool = False,
         verbose: bool = True,
         env: Optional[dict] = None,
+        runs_ledger: Optional[str] = None,
     ):
         self.cmd = list(cmd)
         # the child must resolve the package even when it is not
@@ -152,6 +153,10 @@ class Supervisor:
         self.backoff_cap = backoff_cap
         self.keep_fault_plan = keep_fault_plan
         self.verbose = verbose
+        #: cross-run ledger (obs.runs JSONL): one record per EPISODE,
+        #: so the history survives even when the per-run ledger JSON is
+        #: overwritten by the next supervision
+        self.runs_ledger = runs_ledger
         self.episodes: List[dict] = []
         self.restarts = 0  # crash/stall restarts (budgeted + backoff)
         self.resumes = 0   # rc-75 requeues (budgeted, no backoff)
@@ -348,6 +353,7 @@ class Supervisor:
             action, result = self._decide(cls)
             episode["action"] = action
             self.episodes.append(episode)
+            self._append_episode_record(episode, result)
             self._log(f"episode {n}: rc={rc} class={cls} -> {action}")
             self.write_ledger(result)
             if action == "stop":
@@ -358,6 +364,11 @@ class Supervisor:
                 self._log(f"backing off {pause:.1f}s before restart")
                 time.sleep(pause)
         self.write_ledger(result)
+        if result not in ("done", "terminated"):
+            # the supervision ended badly: merge the evidence into ONE
+            # timeline NOW, while it is fresh — the operator reads a
+            # postmortem, not four artifact files
+            self.write_postmortem(result)
         if result == "done":
             return 0
         # a SIGKILLed child reports a negative rc; normalize so the
@@ -407,6 +418,71 @@ class Supervisor:
             json.dump(payload, f, indent=2)
             f.write("\n")
         os.replace(tmp, path)
+
+    def child_flight(self) -> Optional[str]:
+        """The child's ``--flight`` dump path, scanned off the argv —
+        the black box the postmortem opens when an episode hard-dies."""
+        for i, tok in enumerate(self.cmd):
+            if tok == "--flight" and i + 1 < len(self.cmd):
+                return self.cmd[i + 1]
+            if tok.startswith("--flight="):
+                return tok.split("=", 1)[1]
+        return None
+
+    def _append_episode_record(self, episode: dict, result: str) -> None:
+        """One cross-run ledger record per episode (obs.runs schema).
+        The per-run ledger JSON is atomically OVERWRITTEN each episode;
+        the append-only runs ledger is where episode history outlives
+        the next supervision.  Best-effort by contract."""
+        if not self.runs_ledger:
+            return
+        try:
+            from fluxdistributed_tpu.obs import runs as runs_lib
+
+            cls = episode["class"]
+            runs_lib.append_run(self.runs_ledger, runs_lib.run_record(
+                "episode",
+                phase=cls,
+                retryable=cls in ("preempted", "crashed", "stalled",
+                                  "escalated"),
+                error=(None if cls == "done" else
+                       f"episode class={cls} rc={episode['rc']}"),
+                metrics={"steps": episode["steps"],
+                         "wall_seconds": episode["wall_seconds"]},
+                flight=self.child_flight(),
+                episode=episode["n"],
+                action=episode["action"],
+                result=result,
+            ))
+        except Exception as e:  # noqa: BLE001 — forensics only
+            self._log(f"runs-ledger append failed: "
+                      f"{type(e).__name__}: {e}")
+
+    def write_postmortem(self, result: str) -> Optional[str]:
+        """Merge the child's flight dump + this supervision's episode
+        ledger into one human-readable timeline (obs.runs), print it to
+        stderr and (with ``--ledger``) persist it alongside as
+        ``<ledger>.postmortem.txt``.  Returns the written path."""
+        try:
+            from fluxdistributed_tpu.obs import runs as runs_lib
+
+            text = runs_lib.postmortem_timeline(
+                flight_path=self.child_flight(),
+                supervisor_ledger=self.ledger_path,
+                runs_path=self.runs_ledger)
+            text += f"\nsupervision result: {result}"
+            print(text, file=sys.stderr)
+            if not self.ledger_path:
+                return None
+            path = self.ledger_path + ".postmortem.txt"
+            with open(path, "w") as f:
+                f.write(text + "\n")
+            self._log(f"postmortem written to {path}")
+            return path
+        except Exception as e:  # noqa: BLE001 — the postmortem must
+            # never mask the real exit code
+            self._log(f"postmortem failed: {type(e).__name__}: {e}")
+            return None
 
     def _log(self, msg: str) -> None:
         if self.verbose:
@@ -484,6 +560,88 @@ def smoke(args) -> int:
     return 0
 
 
+def crash_smoke(args) -> int:
+    """The crash-forensics CI gate: a fault plan ``os._exit``s the
+    driver at step 12 — the SIGKILL shape (no ``finally``, no flight
+    footer) — with the flight recorder on, then asserts the black box
+    did its one job: the dump is readable, footer-LESS, and its last
+    flushed record names a step within one flush interval of death;
+    and the merged postmortem calls the death hard."""
+    import tempfile
+
+    work = args.artifacts or tempfile.mkdtemp(prefix="fdtpu-crash-smoke-")
+    os.makedirs(work, exist_ok=True)
+    flight = os.path.join(work, "crash-flight.jsonl")
+    runs_ledger = os.path.join(work, "crash-runs.jsonl")
+    ledger = args.ledger or os.path.join(work, "crash-ledger.json")
+    kill_at = 12
+    plan = {"fail": [{"site": "step", "at": kill_at, "action": "exit"}]}
+    cmd = [
+        sys.executable, os.path.join(REPO, "bin", "driver.py"),
+        "--model", "SimpleCNN", "--dataset", "synthetic",
+        "--num-classes", "4", "--image-size", "8",
+        "--batch-size", "8", "--cycles", "20",
+        "--print-every", "5", "--eval-every", "0",
+        "--platform", "cpu", "--local-devices", "2",
+        "--flight", flight,
+        "--runs-ledger", runs_ledger,
+        "--fault-plan", json.dumps(plan),
+    ]
+    sup = Supervisor(
+        cmd, ledger=ledger, runs_ledger=runs_ledger,
+        max_restarts=0,  # forensics gate: the DEATH is the product
+        startup_grace=300.0, poll_interval=0.25,
+        verbose=not args.quiet)
+    rc = sup.run()
+    from fluxdistributed_tpu.obs.flight import read_flight
+    from fluxdistributed_tpu.obs.runs import load_runs
+
+    problems = []
+    if rc == 0:
+        problems.append("the killed run reported rc 0")
+    try:
+        fl = read_flight(flight)
+    except OSError as e:
+        print(f"crash smoke FAILED: no flight dump at {flight}: {e}",
+              file=sys.stderr)
+        return 1
+    recs = fl["records"]
+    flush_every = int((fl["header"] or {}).get("flush_every", 8))
+    if fl["header"] is None:
+        problems.append("flight dump has no header")
+    if not recs:
+        problems.append("flight dump has no records")
+    if fl["end"] is not None:
+        problems.append(
+            f"a hard death left an end footer: {fl['end']} — dump() ran "
+            "on a path that must not reach it")
+    last_step = recs[-1].get("step", -1) if recs else -1
+    if recs and not (kill_at - 1 - flush_every
+                     <= last_step <= kill_at - 1):
+        problems.append(
+            f"last flushed record step {last_step} is not within one "
+            f"flush interval ({flush_every}) of death step {kill_at}")
+    pm_path = ledger + ".postmortem.txt"
+    try:
+        with open(pm_path) as f:
+            pm = f.read()
+    except OSError:
+        pm, problems = "", problems + [
+            f"no postmortem written at {pm_path}"]
+    if pm and "hard death" not in pm:
+        problems.append("postmortem does not call the death hard")
+    eps = [r for r in load_runs(runs_ledger) if r.get("kind") == "episode"]
+    if not eps:
+        problems.append("no episode record in the runs ledger")
+    if problems:
+        print("crash smoke FAILED:", "; ".join(problems), file=sys.stderr)
+        return 1
+    print(f"crash smoke OK: {len(recs)} records flushed, last step "
+          f"{last_step} (death at {kill_at}, flush interval "
+          f"{flush_every}), footer absent, postmortem at {pm_path}")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description=__doc__.split("\n")[0],
@@ -510,25 +668,39 @@ def main(argv=None) -> int:
                         "hang is not replayed forever)")
     p.add_argument("--quiet", action="store_true",
                    help="suppress child log forwarding")
+    p.add_argument("--runs-ledger", default=None, metavar="PATH",
+                   help="append one obs.runs record per EPISODE here "
+                        "(the append-only cross-run history "
+                        "bin/trends.py reads; the --ledger JSON is "
+                        "overwritten per episode, this is not)")
     p.add_argument("--smoke", action="store_true",
                    help="run the self-contained NaN+hang CI smoke "
                         "instead of a user command")
+    p.add_argument("--crash-smoke", action="store_true",
+                   help="run the crash-forensics CI smoke: fault-plan "
+                        "hard kill -> flight dump + postmortem asserted")
+    p.add_argument("--artifacts", default=None, metavar="DIR",
+                   help="where --crash-smoke leaves its flight dump / "
+                        "ledgers / postmortem (default: a tmpdir)")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="child command after `--`")
     args = p.parse_args(argv)
     if args.smoke:
         return smoke(args)
+    if args.crash_smoke:
+        return crash_smoke(args)
     cmd = args.cmd
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
     if not cmd:
         p.error("no child command given (append `-- python bin/driver.py "
-                "...`, or use --smoke)")
+                "...`, or use --smoke / --crash-smoke)")
     sup = Supervisor(
         cmd, ledger=args.ledger, max_restarts=args.max_restarts,
         max_resumes=args.max_resumes, stall_timeout=args.stall_timeout,
         startup_grace=args.startup_grace, backoff=args.backoff,
-        keep_fault_plan=args.keep_fault_plan, verbose=not args.quiet)
+        keep_fault_plan=args.keep_fault_plan, verbose=not args.quiet,
+        runs_ledger=args.runs_ledger)
     return sup.run()
 
 

@@ -17,7 +17,13 @@ One registry, four producers, three consumers:
 * :mod:`.comms` — the collective-traffic ledger (jaxpr + compiled-HLO
   collective counts/bytes per step per mesh axis);
 * :mod:`.server` — stdlib-HTTP ``/metrics`` + ``/healthz`` (the
-  training-side analog of the LM server's endpoints).
+  training-side analog of the LM server's endpoints);
+* :mod:`.flight` — the black-box flight recorder: a bounded ring of
+  per-step records flushed append-only with atomic checkpoints, so a
+  SIGKILL loses at most one flush interval of history;
+* :mod:`.runs` — the cross-run ledger (``runs.jsonl``): one record per
+  run/round/episode keyed by topology fingerprint, with regression
+  gating and the merged postmortem (``bin/trends.py``).
 
 :class:`Observation` bundles the per-run pieces for the trainer:
 ``train(task, observation=Observation.full(trace_path="run.trace.json"))``
@@ -31,7 +37,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
-from . import comms, jaxmon, memstats
+from . import comms, jaxmon, memstats, runs
+from .flight import FlightRecorder, read_flight
 from .memstats import HbmGauges
 from .metrics import (
     Counter,
@@ -50,6 +57,7 @@ from .watchdog import StepWatchdog
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "HbmGauges",
     "Histogram",
@@ -70,6 +78,8 @@ __all__ = [
     "innermost_active",
     "jaxmon",
     "memstats",
+    "read_flight",
+    "runs",
     "start_metrics_server",
 ]
 
@@ -109,6 +119,12 @@ class Observation:
     # static per-layer/step costs + the run's measured phase data) here
     # when training ends — the planner-facing output of a profiled run
     profile_path: Optional[str] = None
+    # black-box flight recorder: either pass a live FlightRecorder
+    # (``flight``) or a path (``flight_path``) and ``train`` constructs
+    # one; the dump survives any exit including SIGKILL (minus at most
+    # one flush interval)
+    flight: Optional[FlightRecorder] = None
+    flight_path: Optional[str] = None
 
     @classmethod
     def default(cls) -> "Observation":
@@ -125,6 +141,7 @@ class Observation:
         steady_after: Optional[int] = None,
         jsonl_path: Optional[str] = None,
         profile_path: Optional[str] = None,
+        flight_path: Optional[str] = None,
     ) -> "Observation":
         """Everything on: spans (the trainer feeds the phase histogram
         from the same brackets), stall watchdog, per-step device sync."""
@@ -138,4 +155,5 @@ class Observation:
             steady_after=steady_after,
             jsonl_path=jsonl_path,
             profile_path=profile_path,
+            flight_path=flight_path,
         )

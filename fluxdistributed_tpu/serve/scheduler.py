@@ -124,7 +124,8 @@ class Scheduler:
     def __init__(self, engine: LMEngine, max_queue: int = 64,
                  registry: Optional[Registry] = None,
                  prefill_chunks_per_tick: int = 1,
-                 reqtrace: Optional[RequestTracer] = None):
+                 reqtrace: Optional[RequestTracer] = None,
+                 flight=None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         if prefill_chunks_per_tick < 1:
@@ -149,6 +150,11 @@ class Scheduler:
         #: request-scoped lifecycle tracer (obs.reqtrace), or None —
         #: events cost nothing when absent, a bounded ring when present
         self.reqtrace = reqtrace
+        #: black-box flight recorder (obs.flight.FlightRecorder), or
+        #: None — one record per tick, so a replica killed mid-serve
+        #: leaves a dump saying which tick it died on and what the
+        #: queue/slots looked like
+        self.flight = flight
         self.registry = registry if registry is not None else Registry()
         r, p = self.registry, METRIC_PREFIX
         c, g = r.counter, r.gauge
@@ -251,6 +257,12 @@ class Scheduler:
         from ..obs.memstats import HbmGauges
 
         self.hbm = HbmGauges(self.registry)
+        # the fdtpu_run_info stitch gauge (fingerprint/jax/schema
+        # labels) on THIS registry, so a replica scrape names the run
+        # its flight dump and ledger rows belong to
+        from ..obs import runs as runs_lib
+
+        runs_lib.set_run_info(self.registry, "serve")
         self._callback_gauges = [
             p + k for k in (
                 "queue_depth", "active_slots", "max_slots",
@@ -315,6 +327,10 @@ class Scheduler:
         successor scheduler's get-or-create continues them)."""
         for name in self._callback_gauges:
             self.registry.unregister(name)
+        if self.flight is not None:
+            # a retired scheduler is a SOFT exit — footer it (a killed
+            # replica never reaches here, which is the signature)
+            self.flight.dump("closed", ticks=self._ticks)
 
     # ---- producer side (any thread) ---------------------------------------
 
@@ -550,6 +566,17 @@ class Scheduler:
                     emitted += 1
             self._g_chunks_last.set(chunks_run)
         self._sync_prefix_counters()
+        if self.flight is not None:
+            # per-tick black-box record: the serve analog of the
+            # trainer's per-step record (a killed replica's dump says
+            # which tick died and what the queue looked like)
+            self.flight.record(
+                tick=self._ticks - 1,
+                emitted=emitted,
+                active_slots=self.active_slots,
+                queue_depth=self.queue_depth,
+                chunks=chunks_run,
+            )
         return emitted
 
     def run_until_idle(self, max_steps: int = 1_000_000) -> None:

@@ -29,6 +29,7 @@ from __future__ import annotations
 import contextlib
 import copy
 import dataclasses
+import sys
 import time
 from typing import Any, Callable, Iterable, Optional, Sequence
 
@@ -1223,6 +1224,16 @@ class _PhaseClock:
             "(data_wait/h2d/dispatch/device/eval/checkpoint)",
             labelnames=("phase",),
         )
+        # per-phase seconds since the last take() — the flight
+        # recorder's per-record phase breakdown (histograms are
+        # cumulative; the black box needs THIS step's split)
+        self.last: dict = {}
+
+    def take(self) -> dict:
+        """Return-and-clear the per-phase seconds accumulated since the
+        previous call (one flight record's phase breakdown)."""
+        out, self.last = self.last, {}
+        return out
 
     @contextlib.contextmanager
     def __call__(self, name: str, **args):
@@ -1241,7 +1252,9 @@ class _PhaseClock:
             # observe on the exception path too (the span does): an
             # OOM-heavy run must not show artificially fast dispatch
             # percentiles while its trace shows the slow truth
-            self.hist.labels(phase=name).observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.hist.labels(phase=name).observe(dt)
+            self.last[name] = self.last.get(name, 0.0) + dt
             if self.watchdog is not None:
                 from ..obs import memstats
 
@@ -1360,6 +1373,21 @@ def train(
         from ..obs import JsonlSink
 
         sink = JsonlSink(obs.jsonl_path, reg)
+    # black-box flight recorder (obs/flight.py): per-step records that
+    # survive a SIGKILL minus at most one flush interval; the dump in
+    # the finally block stamps every SOFT exit's status — a footer-less
+    # dump is itself the hard-death signature the postmortem keys on
+    flight = obs.flight
+    if flight is None and obs.flight_path:
+        from ..obs.flight import FlightRecorder
+
+        flight = FlightRecorder(obs.flight_path,
+                                meta={"component": "train"})
+    # the fdtpu_run_info stitch gauge: fingerprint/jax/schema labels
+    # joining this registry's scrapes to flight dumps and ledger rows
+    from ..obs import runs as runs_lib
+
+    runs_lib.set_run_info(reg, "train")
     marked_steady = False
     if topk is None:
         # report exactly the metrics compiled into the task's eval step
@@ -1723,8 +1751,74 @@ def train(
                 # a skipped batch is still loop progress — the watchdog
                 # hunts wedged loops, not lost work (that's the counter)
                 obs.watchdog.beat()
+            if flight is not None:
+                # the black box's per-step record: everything a
+                # postmortem needs to name where and how this step went.
+                # record() never raises; the assembly below must not
+                # either — forensics can't be the thing that kills
+                # the flight
+                try:
+                    frec: dict = {
+                        "step": j,
+                        "opt_step": done_steps,
+                        "phases": {k: round(v, 4)
+                                   for k, v in phases.take().items()},
+                    }
+                    if skipped:
+                        frec["skipped"] = True
+                    else:
+                        try:
+                            lm = metrics["loss"]
+                            frec["loss"] = float(
+                                lm[-1] if getattr(lm, "ndim", 0) else lm)
+                        except Exception:  # noqa: BLE001
+                            pass
+                    if verdict is not None:
+                        frec["guard_verdict"] = verdict
+                        z = reg.value("fdtpu_guard_last_z")
+                        if z is not None:
+                            frec["guard_z"] = round(float(z), 3)
+                    if hbm.available:
+                        hr = memstats_lib.min_headroom_ratio()
+                        if hr == hr:  # NaN = unavailable, not 0
+                            frec["headroom"] = round(hr, 4)
+                    compiles = reg.value("fdtpu_jax_compiles_total")
+                    if compiles:
+                        frec["compiles"] = int(compiles)
+                    sr = reg.value("fdtpu_jax_steady_recompiles_total")
+                    if sr:
+                        frec["steady_recompiles"] = int(sr)
+                    if task.num_missed:
+                        frec["oom_skipped"] = int(task.num_missed)
+                    stalled = reg.value("fdtpu_watchdog_stalled")
+                    if stalled:
+                        frec["stalled"] = int(stalled)
+                    flight.record(**frec)
+                except Exception:  # noqa: BLE001 — never kill the loop
+                    pass
             j += 1
     finally:
+        if flight is not None:
+            # stamp every SOFT exit's verdict into the footer (a
+            # SIGKILL never reaches here — the footer-less dump is
+            # exactly the hard-death signature read_flight reports)
+            try:
+                etype, evalue = sys.exc_info()[:2]
+                if etype is None:
+                    flight.dump("done", steps=done_steps)
+                elif issubclass(etype, faults_lib.Preempted):
+                    flight.dump("preempted", error=str(evalue),
+                                steps=done_steps)
+                else:
+                    from .guard import GuardHalt
+
+                    flight.dump(
+                        "halt" if issubclass(etype, GuardHalt)
+                        else "crash",
+                        error=f"{etype.__name__}: {evalue}",
+                        steps=done_steps)
+            except Exception:  # noqa: BLE001
+                pass
         if preempt is not None:
             preempt.uninstall()
         if obs.watchdog is not None:

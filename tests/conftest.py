@@ -14,3 +14,11 @@ has to go through ``jax.config`` (which ``force_host_devices`` does).
 from fluxdistributed_tpu.mesh import force_host_devices
 
 force_host_devices(8)
+
+# The bench cross-run ledger (bench.append_run_record) defaults to the
+# COMMITTED benchmarks/hw/runs.jsonl — a test run must never append to
+# repo history.  Empty string disables (tests that exercise the ledger
+# monkeypatch.setenv a tmp path over this).
+import os  # noqa: E402
+
+os.environ.setdefault("FDTPU_RUNS_LEDGER", "")

@@ -1,0 +1,250 @@
+"""Flight recorder (obs/flight.py): the black box must survive the
+crash it exists for.
+
+Tier-1 here: ring/flush mechanics, torn-tail tolerance, footer
+idempotence, trainer wiring, and a SIMULATED hard death (a subprocess
+that records then ``os._exit``s — the no-finally shape of a SIGKILL,
+without paying a driver launch).  The real-SIGKILL driver kill rides
+the slow tier below; CI's ``supervise.py --crash-smoke`` exercises the
+same path end-to-end."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from fluxdistributed_tpu.obs.flight import (
+    FLIGHT_SCHEMA,
+    FlightRecorder,
+    read_flight,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_ring_and_flush_cadence(tmp_path):
+    """Records flush every ``flush_every``; the gap between recorded
+    and flushed — the maximum a SIGKILL can lose — never exceeds one
+    interval."""
+    p = str(tmp_path / "f.jsonl")
+    fr = FlightRecorder(p, ring=6, flush_every=4)
+    for i in range(10):
+        fr.record(step=i)
+        assert fr.recorded - fr.flushed < 4
+    assert fr.recorded == 10
+    assert fr.flushed == 8  # two cadence flushes; 2 pending
+    # the ring keeps only the newest 6 in memory
+    assert [r["step"] for r in fr.records()] == [4, 5, 6, 7, 8, 9]
+    # on disk: header + the 8 flushed records, no footer yet
+    out = read_flight(p)
+    assert out["header"]["schema"] == FLIGHT_SCHEMA
+    assert out["header"]["flush_every"] == 4
+    assert [r["step"] for r in out["records"]] == list(range(8))
+    assert out["end"] is None
+    # the sidecar checkpoint is consistent with the last flush: it
+    # names the newest DURABLE record, not the in-memory tail
+    assert out["checkpoint"]["flushed"] == 8
+    assert out["checkpoint"]["last"]["step"] == 7
+
+
+def test_dump_flushes_remainder_and_is_idempotent(tmp_path):
+    p = str(tmp_path / "f.jsonl")
+    fr = FlightRecorder(p, flush_every=8, fingerprint="fp-test")
+    for i in range(5):
+        fr.record(step=i)
+    assert fr.flushed == 0  # below cadence: nothing durable yet
+    assert fr.dump("done", steps=5) == p
+    fr.dump("crash")  # second verdict must not rewrite history
+    fr.record(step=99)  # post-dump records are dropped, not appended
+    out = read_flight(p)
+    assert [r["step"] for r in out["records"]] == list(range(5))
+    assert out["end"]["status"] == "done"
+    assert out["end"]["records"] == 5
+    assert out["end"]["fingerprint"] == "fp-test"
+
+
+def test_reader_tolerates_torn_tail(tmp_path):
+    """A SIGKILL mid-append tears at most the final line; the reader
+    must count it and keep every complete record."""
+    p = str(tmp_path / "f.jsonl")
+    fr = FlightRecorder(p, flush_every=2)
+    for i in range(4):
+        fr.record(step=i)
+    with open(p, "a") as f:
+        f.write('{"kind": "record", "step": 4, "trunc')  # the tear
+    out = read_flight(p)
+    assert [r["step"] for r in out["records"]] == [0, 1, 2, 3]
+    assert out["torn"] == 1
+    assert out["end"] is None
+
+
+def test_record_never_raises_on_dead_path(tmp_path, capsys):
+    """The black box must not be able to kill the loop it watches: an
+    unwritable path degrades to in-memory recording + one warning.
+    (A regular file poses as the parent dir — NotADirectoryError hits
+    even when the suite runs as root, where chmod would not.)"""
+    (tmp_path / "nope").write_text("a file, not a directory")
+    p = str(tmp_path / "nope" / "f.jsonl")
+    fr = FlightRecorder(p, flush_every=1)
+    for i in range(3):
+        fr.record(step=i)  # must not raise
+    assert fr.recorded == 3
+    assert fr.flushed == 0
+    err = capsys.readouterr().err
+    assert err.count("obs.flight") == 1  # warned once, not per record
+
+
+def test_simulated_hard_death_loses_at_most_one_interval(tmp_path):
+    """The fast crash test: a subprocess records steps then
+    ``os._exit(9)``s — no finally blocks, no dump(), the exact shape
+    of a SIGKILL — and the dump it leaves must be readable, footer-less
+    and at most one flush interval behind the death step."""
+    p = str(tmp_path / "crash.jsonl")
+    n, flush_every = 21, 4
+    script = textwrap.dedent(f"""
+        import importlib.util, os
+        spec = importlib.util.spec_from_file_location(
+            "flight", {os.path.join(REPO, 'fluxdistributed_tpu', 'obs', 'flight.py')!r})
+        flight = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(flight)
+        fr = flight.FlightRecorder({p!r}, flush_every={flush_every})
+        for i in range({n}):
+            fr.record(step=i, loss=1.0 / (i + 1))
+        os._exit(9)  # hard death: no finally, no dump
+    """)
+    proc = subprocess.run([sys.executable, "-c", script], timeout=60)
+    assert proc.returncode == 9
+    out = read_flight(p)
+    assert out["end"] is None  # the hard-death signature
+    assert out["records"], "a crash left no flushed records"
+    last = out["records"][-1]["step"]
+    assert last >= n - 1 - flush_every, (
+        f"lost more than one flush interval: last flushed step {last}, "
+        f"death after step {n - 1}, flush_every {flush_every}")
+    # the atomic sidecar survived too, consistent with the dump
+    assert out["checkpoint"]["flushed"] == len(out["records"])
+
+
+def test_trainer_wires_flight_records_and_footer(tmp_path):
+    """``train(observation=Observation(flight_path=...))`` leaves a
+    dump with one record per loader item (step, loss, phase seconds)
+    and a ``done`` footer — and registers the ``fdtpu_run_info``
+    stitch gauge."""
+    from fluxdistributed_tpu import mesh as mesh_lib, optim
+    from fluxdistributed_tpu.data import SyntheticDataset
+    from fluxdistributed_tpu.models import SimpleCNN
+    from fluxdistributed_tpu.obs import Observation, Registry
+    from fluxdistributed_tpu.train import NullLogger, prepare_training, train
+
+    mesh = mesh_lib.data_mesh(8)
+    ds = SyntheticDataset(nsamples=64, nclasses=4, shape=(8, 8, 3))
+    task = prepare_training(
+        SimpleCNN(num_classes=4), ds, optim.momentum(0.05, 0.9),
+        mesh=mesh, batch_size=16, cycles=4)
+    p = str(tmp_path / "train-flight.jsonl")
+    reg = Registry()
+    obs = Observation(registry=reg, flight_path=p)
+    train(task, print_every=0, eval_every=0, logger=NullLogger(),
+          observation=obs)
+    out = read_flight(p)
+    assert out["end"]["status"] == "done"
+    steps = [r["step"] for r in out["records"]]
+    assert steps == sorted(steps) and len(steps) == 4
+    rec = out["records"][-1]
+    assert isinstance(rec["loss"], float)
+    assert "dispatch" in rec["phases"]
+    assert rec["opt_step"] == 4
+    # the stitch gauge landed on the run's registry, info-style
+    assert "fdtpu_run_info{" in reg.prometheus_text()
+
+
+class _FakeEngine:
+    """Pure-python LMEngine stand-in (mirrors tests/test_obs.py): the
+    scheduler's flight wiring runs without compiling anything."""
+
+    max_slots = 2
+
+    def validate_request(self, prompt_len, max_new_tokens):
+        pass
+
+    def prefill(self, slot, prompt, temperature, key):
+        return 7, 8  # (first token, padded bucket size)
+
+    def step_decode(self):
+        return [1] * self.max_slots
+
+    def reset_slot(self, slot):
+        pass
+
+    def compile_stats(self):
+        return {"decode_compiles": 1, "prefill_compiles": 2,
+                "insert_compiles": 1}
+
+
+def test_scheduler_per_tick_records_and_close_footer(tmp_path):
+    """The serve scheduler records one line per tick and footers the
+    dump on close() — a killed replica's dump names its last tick."""
+    from fluxdistributed_tpu.serve import Request, Scheduler
+
+    p = str(tmp_path / "serve-flight.jsonl")
+    fr = FlightRecorder(p, flush_every=2)
+    sched = Scheduler(_FakeEngine(), max_queue=4, flight=fr)
+    sched.submit(Request(prompt=[1, 2, 3], max_new_tokens=3))
+    sched.run_until_idle()
+    sched.close()
+    out = read_flight(p)
+    assert out["end"]["status"] == "closed"
+    assert out["records"], "no per-tick records"
+    ticks = [r["tick"] for r in out["records"]]
+    assert ticks == sorted(ticks)
+    assert sum(r["emitted"] for r in out["records"]) >= 3
+    assert "fdtpu_run_info{" in sched.registry.prometheus_text()
+
+
+@pytest.mark.slow
+def test_real_sigkill_leaves_fresh_dump(tmp_path):
+    """The acceptance-criterion shape, for real: SIGKILL a live
+    ``bin/driver.py --flight`` run mid-step (no fault plan — an actual
+    signal 9 from outside) and the dump must be readable, footer-less
+    and within one flush interval of the last step the driver
+    reported."""
+    p = str(tmp_path / "kill-flight.jsonl")
+    cmd = [
+        sys.executable, os.path.join(REPO, "bin", "driver.py"),
+        "--model", "SimpleCNN", "--dataset", "synthetic",
+        "--num-classes", "4", "--image-size", "8",
+        "--batch-size", "8", "--cycles", "400",
+        "--print-every", "1", "--eval-every", "0",
+        "--platform", "cpu", "--local-devices", "2",
+        "--flight", p,
+    ]
+    env = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+           "PYTHONUNBUFFERED": "1"}  # the pipe must see cycle lines live
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                            stderr=subprocess.DEVNULL, env=env)
+    seen = -1
+    try:
+        for line in proc.stdout:
+            if line.startswith("cycle "):
+                seen = int(line.split()[1])
+                if seen >= 12:
+                    break
+        assert seen >= 12, "driver never reached cycle 12"
+        proc.kill()  # SIGKILL: no finally, no dump
+    finally:
+        proc.stdout.close()
+        rc = proc.wait(timeout=60)
+    assert rc == -9
+    out = read_flight(p)
+    assert out["end"] is None, "a SIGKILL must not leave a footer"
+    assert out["records"], "no flushed records survived the kill"
+    flush_every = out["header"]["flush_every"]
+    last = out["records"][-1]["step"]
+    # the driver logs "cycle N" before the step runs, so death is at
+    # some step >= seen; the last FLUSHED record must be within one
+    # flush interval of the last step provably started
+    assert last >= seen - flush_every, (
+        f"dump is stale: last flushed step {last}, driver reached "
+        f"cycle {seen}, flush_every {flush_every}")
