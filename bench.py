@@ -270,13 +270,16 @@ def memory_stamp(state=None):
 
 def lint_stamp():
     """The static-health stamp for the bench JSON: the AST-layer
-    rule-count summary + new-vs-baseline count from the fdtpu-lint suite
-    (milliseconds, no jax tracing — safe inside the bounded measurement
-    subprocess).  A hardware round whose artifact says ``"new": 0``
-    provably ran code the analyzer had no fresh complaints about; a
-    non-zero count flags the round as statically suspect before anyone
-    re-burns a grant window reproducing it.  Never raises — forensics
-    must not kill the bench."""
+    (FDT1xx) + concurrency-layer (FDT3xx) rule-count summary, a
+    per-layer ``"layers"`` breakdown, and the new-vs-baseline count
+    from the fdtpu-lint suite (seconds of pure host-side parsing, no
+    jax tracing — safe inside the bounded measurement subprocess).  A
+    hardware round whose artifact says ``"new": 0`` provably ran code
+    the analyzer had no fresh complaints about — including no unlocked
+    shared-state writes or lock-order cycles; a non-zero count flags
+    the round as statically suspect before anyone re-burns a grant
+    window reproducing it.  Never raises — forensics must not kill the
+    bench."""
     try:
         from fluxdistributed_tpu import analysis
 
@@ -390,8 +393,9 @@ def memory_stamp_bounded(seconds: float = 30.0):
 
 def lint_stamp_bounded(seconds: float = 60.0):
     """:func:`lint_stamp` under a wall bound for error paths: pure
-    host-side AST work in theory, but it globs + parses the whole tree
-    — a hung NFS mount must not wedge the error report either."""
+    host-side AST work in theory (both layers — the concurrency pass
+    re-parses the tree too), but it globs + parses the whole tree — a
+    hung NFS mount must not wedge the error report either."""
     return _bounded_stamp(lint_stamp, seconds, "bench.lint_stamp")
 
 

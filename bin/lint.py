@@ -52,8 +52,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit findings + summary as one JSON object")
     p.add_argument("--no-jaxpr", action="store_true",
-                   help="skip the jaxpr layer (AST rules only — no jax "
-                        "import, milliseconds)")
+                   help="skip the jaxpr layer (AST + concurrency rules "
+                        "only — no jax import, seconds)")
+    p.add_argument("--no-concurrency", action="store_true",
+                   help="skip the FDT3xx concurrency layer (lock "
+                        "coverage / lock order / thread lifecycle — "
+                        "stdlib-ast, on by default even for explicit "
+                        "paths)")
     p.add_argument("--jaxpr", action="store_true",
                    help="run the jaxpr layer even when explicit paths "
                         "are given")
@@ -87,6 +92,10 @@ def main(argv=None) -> int:
     findings = (analysis.scan_paths(args.paths) if args.paths
                 else analysis.scan_repo())
 
+    run_conc = not args.no_concurrency
+    if run_conc:
+        findings += analysis.run_concurrency_checks(args.paths or None)
+
     run_jaxpr = (args.jaxpr or not args.paths) and not args.no_jaxpr
     if run_jaxpr:
         # the 8-virtual-device mesh must be pinned before jax touches a
@@ -103,17 +112,24 @@ def main(argv=None) -> int:
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
 
     if args.update_baseline:
-        # a partial-scope run (explicit paths / --no-jaxpr) must not
-        # erase allowlist entries it could not have re-observed: keep
-        # AST entries for unscanned files, and jaxpr-layer (FDT2xx)
-        # entries whenever the jaxpr layer did not run
+        # a partial-scope run (explicit paths / --no-jaxpr /
+        # --no-concurrency) must not erase allowlist entries it could
+        # not have re-observed: keep AST and concurrency entries for
+        # unscanned files (or whenever their layer did not run), and
+        # jaxpr-layer (FDT2xx) entries whenever the jaxpr layer did
+        # not run
         scanned = set(analysis.scanned_files(args.paths or None))
-        keep = [
-            e for e in analysis.load_baseline(baseline_path)
-            if (e.get("file") not in scanned
-                if not e.get("rule", "").startswith("FDT2")
-                else not run_jaxpr)
-        ]
+
+        def _keep(e: dict) -> bool:
+            rule = e.get("rule", "")
+            if rule.startswith("FDT2"):
+                return not run_jaxpr
+            if rule.startswith("FDT3"):
+                return not run_conc or e.get("file") not in scanned
+            return e.get("file") not in scanned
+
+        keep = [e for e in analysis.load_baseline(baseline_path)
+                if _keep(e)]
         analysis.save_baseline(baseline_path, findings, keep=keep)
         print(f"lint: wrote {len(findings)} finding(s) + {len(keep)} "
               f"kept out-of-scope entr(ies) to {baseline_path}")

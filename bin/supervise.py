@@ -471,6 +471,20 @@ class Supervisor:
                 supervisor_ledger=self.ledger_path,
                 runs_path=self.runs_ledger)
             text += f"\nsupervision result: {result}"
+            # static-health stamp (own guard: the lint pass parsing the
+            # tree must not take the postmortem down with it) — a crash
+            # report that says "new: 3, concurrency: 2" points straight
+            # at an unlocked write before anyone replays the run
+            try:
+                import json as _json
+
+                from fluxdistributed_tpu import analysis
+                text += ("\nlint stamp: "
+                         + _json.dumps(analysis.lint_verdict(),
+                                       sort_keys=True))
+            except Exception as e:  # noqa: BLE001 — forensics only
+                text += (f"\nlint stamp: unavailable "
+                         f"({type(e).__name__}: {e})"[:200])
             print(text, file=sys.stderr)
             if not self.ledger_path:
                 return None
@@ -630,6 +644,8 @@ def crash_smoke(args) -> int:
             f"no postmortem written at {pm_path}"]
     if pm and "hard death" not in pm:
         problems.append("postmortem does not call the death hard")
+    if pm and "lint stamp:" not in pm:
+        problems.append("postmortem lacks the static-health lint stamp")
     eps = [r for r in load_runs(runs_ledger) if r.get("kind") == "episode"]
     if not eps:
         problems.append("no episode record in the runs ledger")

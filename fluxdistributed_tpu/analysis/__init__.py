@@ -1,6 +1,6 @@
 """fdtpu-lint: JAX-hazard static analysis for this repo.
 
-Two layers (see ISSUE 5 / docs/analysis.md):
+Three layers (see ISSUE 5 / ISSUE 20 / docs/analysis.md):
 
 * **AST rules** (:mod:`analysis.rules_ast`, run by
   :mod:`analysis.engine`) — stdlib-``ast`` scanning for tracer
@@ -13,6 +13,12 @@ Two layers (see ISSUE 5 / docs/analysis.md):
   8-virtual-device CPU mesh, verifying sharding-spec validity,
   donation consumability, retrace determinism (= AOT-key stability)
   and transfer-cleanliness.
+* **concurrency rules** (:mod:`analysis.concurrency`, FDT3xx) —
+  lock-coverage inference, a cross-module lock-order graph with cycle
+  detection, blocking-while-locked, thread-lifecycle and
+  global-mutation-in-thread audits over the host-side orchestration;
+  paired with the deterministic-schedule race harness
+  (:mod:`analysis.schedules`).  Still stdlib-``ast``, no jax.
 
 ``bin/lint.py`` is the CLI; ``analysis/baseline.json`` allowlists
 pre-existing findings so CI fails only on NEW ones.
@@ -42,9 +48,12 @@ from .engine import (  # noqa: F401
     scanned_files,
 )
 from .rules_ast import AST_RULES, declared_mesh_axes  # noqa: F401
+from .concurrency import CONC_RULES, run_concurrency_checks  # noqa: F401
 
 __all__ = [
     "AST_RULES",
+    "CONC_RULES",
+    "run_concurrency_checks",
     "Finding",
     "SEVERITIES",
     "baseline_key",
@@ -71,13 +80,18 @@ def default_baseline_path() -> str:
 
 def lint_verdict(baseline: Optional[str] = None) -> dict:
     """The static-health stamp for harness output (``bench.py`` embeds
-    it in its JSON line): the AST-layer rule-count summary plus how many
-    findings are NEW vs the checked-in baseline.  AST-only by design —
-    it must cost milliseconds and never trace jax programs inside a
-    bounded hardware-bench subprocess."""
-    findings = scan_repo()
+    it in its JSON line): the AST-layer + concurrency-layer rule-count
+    summary plus how many findings are NEW vs the checked-in baseline.
+    jaxpr-free by design — it must cost seconds at most and never trace
+    jax programs inside a bounded hardware-bench subprocess."""
+    ast_findings = scan_repo()
+    conc_findings = run_concurrency_checks()
+    findings = sorted(ast_findings + conc_findings,
+                      key=lambda f: (f.file, f.line, f.rule))
     base = load_baseline(baseline or default_baseline_path())
     new, _ = diff_findings(findings, base)
     out = summarize(findings, new)
     out["baseline"] = len(base)
+    out["layers"] = {"ast": len(ast_findings),
+                     "concurrency": len(conc_findings)}
     return out

@@ -236,19 +236,25 @@ class FaultPlan:
                 f"unknown fault action {action!r}; one of {FAULT_ACTIONS}")
         if delay < 0:
             raise ValueError(f"delay must be >= 0, got {delay}")
-        self._faults.append(
-            _Fault(site=site, at=at, times=times, exc=exc, message=message,
-                   action=action, delay=float(delay)))
+        # under the lock: plans are usually built before installation,
+        # but a test arming extra faults while a fire() iterates the
+        # list from another thread must not race the traversal
+        with self._lock:
+            self._faults.append(
+                _Fault(site=site, at=at, times=times, exc=exc,
+                       message=message, action=action, delay=float(delay)))
         return self
 
     def sigterm_at_step(self, k: int) -> "FaultPlan":
         """Deliver SIGTERM to this process at the trainer's step
         boundary ``k`` — the deterministic preemption."""
-        self._faults.append(_Fault(site="step", at=k, action="sigterm"))
+        with self._lock:
+            self._faults.append(_Fault(site="step", at=k, action="sigterm"))
         return self
 
     def sigint_at_step(self, k: int) -> "FaultPlan":
-        self._faults.append(_Fault(site="step", at=k, action="sigint"))
+        with self._lock:
+            self._faults.append(_Fault(site="step", at=k, action="sigint"))
         return self
 
     def loader_fail(self, *, at: int = 0, times: int = 1) -> "FaultPlan":

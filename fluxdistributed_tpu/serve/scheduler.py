@@ -342,7 +342,13 @@ class Scheduler:
             self.reqtrace.event("scheduler", "drain_begin",
                                 active=self.active_slots,
                                 queued=self.queue_depth)
-        self.draining = True
+        # under the lock: submit() checks the latch inside its locked
+        # region, so the store must be ordered against in-flight
+        # admissions.  The gauge/tracer calls stay OUTSIDE — they take
+        # the registry lock, and nesting it under the scheduler lock
+        # would create a lock-order edge FDT302 exists to forbid.
+        with self._lock:
+            self.draining = True
         self.registry.gauge(
             "fdtpu_serve_draining",
             "1 while the scheduler refuses new admissions for shutdown",

@@ -1,0 +1,22 @@
+"""FDT301 negative: every write to a covered attribute holds the
+lock; `ticks` is driver-thread-only state the class never locks, so
+it has no coverage to violate (the rule's precision contract)."""
+import threading
+
+
+class Stat:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.ticks = 0  # single-thread state: never lock-covered
+
+    def inc(self):
+        with self._lock:
+            self.count += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self.count
+
+    def tick(self):
+        self.ticks += 1

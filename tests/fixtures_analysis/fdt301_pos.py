@@ -1,0 +1,22 @@
+"""FDT301 positive: `count`/`flag` are lock-covered (accessed under
+`self._lock` in `inc`) but also written with no lock held — the
+read-modify-write is the error shape, the plain store the warning."""
+import threading
+
+
+class Stat:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.flag = False
+
+    def inc(self):
+        with self._lock:
+            self.count += 1
+            self.flag = True
+
+    def racy_bump(self):
+        self.count += 1  # RMW outside the lock — lost updates
+
+    def racy_flag(self):
+        self.flag = False  # unordered store against inc()'s read

@@ -1,0 +1,31 @@
+"""FDT302 negative: the copy-under-lock/render-after-release pattern —
+the registry never calls into the scheduler while holding its lock, so
+the graph has one direction only."""
+import threading
+
+
+class ToyRegistry:
+    def __init__(self, sched=None):
+        self._lock = threading.Lock()
+        self._sched = sched
+
+    def render_exposition(self):
+        with self._lock:
+            target = self._sched  # snapshot under the lock ...
+        return target.scrape_queue_depth()  # ... call after release
+
+
+class ToyScheduler:
+    def __init__(self, registry):
+        self._lock = threading.Lock()
+        self._registry = registry
+
+    def scrape_queue_depth(self):
+        with self._lock:
+            return 0
+
+    def finish_request(self):
+        with self._lock:
+            depth = 0
+        self._registry.render_exposition()
+        return depth

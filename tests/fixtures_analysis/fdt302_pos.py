@@ -1,0 +1,30 @@
+"""FDT302 positive: scrape path takes registry-lock then
+scheduler-lock; finish path takes scheduler-lock then registry-lock —
+two threads on opposite paths deadlock."""
+import threading
+
+
+class ToyRegistry:
+    def __init__(self, sched=None):
+        self._lock = threading.Lock()
+        self._sched = sched
+
+    def render_exposition(self):
+        with self._lock:
+            # registry-lock held -> acquires scheduler-lock
+            return self._sched.scrape_queue_depth()
+
+
+class ToyScheduler:
+    def __init__(self, registry):
+        self._lock = threading.Lock()
+        self._registry = registry
+
+    def scrape_queue_depth(self):
+        with self._lock:
+            return 0
+
+    def finish_request(self):
+        with self._lock:
+            # scheduler-lock held -> acquires registry-lock: the cycle
+            self._registry.render_exposition()

@@ -1,0 +1,16 @@
+"""FDT303 negative: blocking work happens after release (snapshot
+under the lock), and the in-region join carries a timeout bound."""
+import threading
+import urllib.request
+
+
+class Prober:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.status = {}
+
+    def probe(self, url, worker):
+        resp = urllib.request.urlopen(url)  # block BEFORE the lock
+        with self._lock:
+            worker.join(timeout=0.5)  # bounded — cannot stall forever
+            self.status[url] = resp.status

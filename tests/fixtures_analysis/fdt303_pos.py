@@ -1,0 +1,17 @@
+"""FDT303 positive: a network round-trip and an unbounded join run
+inside the lock region — every other thread needing the lock stalls
+behind a remote peer."""
+import threading
+import urllib.request
+
+
+class Prober:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.status = {}
+
+    def probe(self, url, worker):
+        with self._lock:
+            resp = urllib.request.urlopen(url)  # network under the lock
+            worker.join()  # unbounded wait under the lock
+            self.status[url] = resp.status

@@ -1,0 +1,27 @@
+"""FDT304 negative: the worker is daemonized AND joined on the stop
+path; every callback gauge is unregistered in close()."""
+import threading
+
+
+class Pump:
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._thread.join(timeout=1.0)
+
+    def _run(self):
+        pass
+
+
+class Gauges:
+    def __init__(self, registry):
+        self.registry = registry
+        self._callback_gauges = ["fdtpu_toy_depth"]
+        registry.gauge("fdtpu_toy_depth", "toy").set_function(
+            lambda: 0.0)
+
+    def close(self):
+        for name in self._callback_gauges:
+            self.registry.unregister(name)

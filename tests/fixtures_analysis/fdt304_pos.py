@@ -1,0 +1,20 @@
+"""FDT304 positive: a non-daemon worker thread nothing ever joins
+(blocks interpreter exit), and callback-gauge registrations with no
+close path to unregister them (pins the object on shared registries)."""
+import threading
+
+
+class Pump:
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+
+class Gauges:
+    def __init__(self, registry):
+        self.registry = registry
+        registry.gauge("fdtpu_toy_depth", "toy").set_function(
+            lambda: 0.0)

@@ -1,0 +1,14 @@
+"""FDT305 negative: the same mutation under a module-level lock."""
+import threading
+
+_STATS = {}
+_STATS_LOCK = threading.Lock()
+
+
+def _worker():
+    with _STATS_LOCK:
+        _STATS["ticks"] = _STATS.get("ticks", 0) + 1
+
+
+def start():
+    threading.Thread(target=_worker, daemon=True).start()

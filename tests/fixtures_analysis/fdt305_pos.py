@@ -1,0 +1,13 @@
+"""FDT305 positive: the thread target mutates a module global with no
+lock held — concurrent with every other worker and the main thread."""
+import threading
+
+_STATS = {}
+
+
+def _worker():
+    _STATS["ticks"] = _STATS.get("ticks", 0) + 1  # unlocked
+
+
+def start():
+    threading.Thread(target=_worker, daemon=True).start()

@@ -1,0 +1,75 @@
+"""The intentionally-racy toy for the schedule harness
+(tests/test_schedules.py loads it via importlib — like every fixture
+here it is outside the default lint scan).
+
+`submit` locks the read and locks the write but DROPS the lock between
+them, so a concurrent submit in the window is silently overwritten — a
+lost update.  Deliberately shaped so the static layer stays quiet
+(every access holds the lock; FDT301's coverage model cannot see a
+split atomicity assumption): this is precisely the residual bug class
+the deterministic-schedule harness exists for.  The window is a few
+bytecodes wide — under CPython's 5 ms GIL switch interval it
+essentially never loses on its own, which is what makes the
+catches-with/misses-without pair in test_schedules.py a real guard
+against the harness becoming a no-op.
+"""
+import threading
+
+from fluxdistributed_tpu.analysis import schedules
+
+
+class RacyToyScheduler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def submit(self, n=1):
+        with self._lock:
+            current = self.total
+        # BUG: the lock is dropped here — another submit() landing in
+        # this window is overwritten by the stale `current + n` below
+        with self._lock:
+            self.total = current + n
+
+
+class FixedToyScheduler:
+    """The fix the harness pins: one lock region spans read and write."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def submit(self, n=1):
+        with self._lock:
+            self.total = self.total + n
+
+
+def hammer(sched, workers=2, per_worker=1):
+    """`workers` threads, barrier-released together, each submitting
+    `per_worker` times — returns the final total (correct value:
+    workers * per_worker)."""
+    barrier = threading.Barrier(workers)
+
+    def run():
+        barrier.wait()
+        for _ in range(per_worker):
+            sched.submit(1)
+
+    threads = [threading.Thread(target=run, name=f"hammer-{i}")
+               for i in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return sched.total
+
+
+def lost_update_under(plan, cls=RacyToyScheduler):
+    """Instrument a fresh scheduler, hammer it under `plan`, report
+    whether an update was lost.  The forced preemption at the FIRST
+    `.release` crossing lands exactly in the read→write window: the
+    stalled thread resumes with a stale `current` and overwrites the
+    other thread's completed submit."""
+    sched = schedules.instrument(cls())
+    total = schedules.run_under_schedule(plan, lambda: hammer(sched))
+    return total != 2

@@ -1,0 +1,444 @@
+"""Deterministic-schedule race-harness tests (ISSUE 20).
+
+Four blocks:
+
+* **plan mechanics** — crossing accounting, explicit preemptions,
+  seeded fuzz determinism, reproducer spec round-trip and the
+  ``$FDTPU_SCHEDULE_REPRO_DIR`` dump-on-failure path.
+* **interposition** — :func:`schedules.instrument` swaps a live
+  object's primitives (idempotently), traced wrappers behave like the
+  real thing, and the ``cross`` hook is inert with no plan installed.
+* **the toy pair** — the acceptance criterion: the seeded race in
+  ``fixtures_analysis/toy_racy_scheduler.py`` FAILS under its forced
+  schedule, provably does NOT fail without interposition, and the
+  fixed variant survives the same hostile schedule.
+* **real objects** — Scheduler+FakeLMEngine, FaultPlan, StepWatchdog
+  and FlightRecorder run instrumented under preemption/fuzz with their
+  output invariants asserted (tokens match the ``fake_tokens`` oracle,
+  drain admissions are all-or-nothing, no record is lost).
+
+Everything here runs on :class:`FakeLMEngine` — no compiles, so the
+suite belongs in CI's fast job (which exports the repro dir so a
+schedule failure uploads its interleaving with the obs artifacts).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from fluxdistributed_tpu import faults
+from fluxdistributed_tpu.analysis import concurrency, schedules
+from fluxdistributed_tpu.obs import FlightRecorder, Registry, StepWatchdog
+from fluxdistributed_tpu.serve.scheduler import Draining, Request, Scheduler
+from fluxdistributed_tpu.serve.testing import FakeLMEngine, fake_tokens
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures_analysis")
+
+#: the forced interleaving that manifests the toy's lost update: stall
+#: the FIRST release of the toy's lock (the read-region exit) long
+#: enough for the other barrier-released thread to run to completion
+TOY_SITE = "RacyToyScheduler._lock.release"
+
+
+def _load_toy():
+    spec = importlib.util.spec_from_file_location(
+        "toy_racy_scheduler",
+        os.path.join(FIXTURES, "toy_racy_scheduler.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_schedule():
+    # a leaked plan would silently stall every other test's locks
+    yield
+    schedules.clear_schedule()
+    assert schedules.active_schedule() is None
+
+
+# ------------------------------------------------------------ plan mechanics
+
+def test_plan_counts_crossings_and_fires_explicit_preempt():
+    plan = schedules.SchedulePlan()
+    plan.preempt_at("a.acquire", at=2, delay=0.0)
+    for _ in range(3):
+        plan.cross("a.acquire")
+    plan.cross("b.release")
+    assert plan.crossings("a.acquire") == 3
+    assert plan.crossings() == {"a.acquire": 3, "b.release": 1}
+    assert plan.fired == 1  # only the at=2 crossing
+    log = plan.spec()["log"]
+    assert [e["n"] for e in log if e["site"] == "a.acquire"] == [1, 2, 3]
+    hits = [e for e in log if e["hit"]]
+    assert [(e["site"], e["n"]) for e in hits] == [("a.acquire", 2)]
+
+
+def test_plan_validates_arguments():
+    plan = schedules.SchedulePlan()
+    with pytest.raises(ValueError):
+        plan.preempt_at("x", at=0)
+    with pytest.raises(ValueError):
+        plan.preempt_at("x", delay=-1.0)
+    with pytest.raises(ValueError):
+        plan.fuzz(prob=1.5)
+
+
+def test_fuzz_is_a_pure_function_of_seed_and_crossing():
+    def stall_pattern(seed):
+        plan = schedules.SchedulePlan(seed=seed).fuzz(prob=0.5, delay=0.0)
+        for i in range(40):
+            plan.cross(f"site{i % 4}.held")
+        return tuple(e["hit"] for e in plan.spec()["log"])
+
+    a, b = stall_pattern(11), stall_pattern(11)
+    assert a == b  # same seed, same crossings -> identical schedule
+    assert any(a) and not all(a)  # prob=0.5 actually mixes
+    assert stall_pattern(12) != a  # and the seed matters
+
+
+def test_spec_roundtrip_and_dump(tmp_path):
+    plan = schedules.SchedulePlan(seed=7)
+    plan.preempt_at("s.release", at=3, times=2, delay=0.01)
+    plan.fuzz(prob=0.1, delay=0.002)
+    spec = plan.spec()
+    assert spec["schema"] == "fdtpu-schedule-repro/v1"
+
+    clone = schedules.SchedulePlan.from_spec(spec)
+    # the clone re-injects the same schedule: same seed, same table
+    cs = clone.spec()
+    assert cs["seed"] == 7
+    assert cs["preempt"] == spec["preempt"]
+    assert cs["fuzz"] == spec["fuzz"]
+
+    path = plan.dump(str(tmp_path / "sub" / "repro.json"))
+    on_disk = json.load(open(path))
+    assert on_disk["schema"] == "fdtpu-schedule-repro/v1"
+    assert on_disk["preempt"][0]["site"] == "s.release"
+
+
+def test_run_under_schedule_dumps_reproducer_on_failure(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv(schedules.REPRO_DIR_ENV, str(tmp_path))
+    plan = schedules.SchedulePlan(seed=3).preempt_at("x.held", delay=0.0)
+
+    def boom():
+        schedules.cross("x.held")
+        raise AssertionError("race caught")
+
+    with pytest.raises(AssertionError):
+        schedules.run_under_schedule(plan, boom, repro_name="toy")
+    assert schedules.active_schedule() is None  # cleared even on raise
+    repro = json.load(open(tmp_path / "toy-seed3.json"))
+    assert repro["schema"] == "fdtpu-schedule-repro/v1"
+    assert repro["fired"] == 1
+    assert repro["crossings"] == {"x.held": 1}
+
+
+def test_run_under_schedule_success_path_no_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv(schedules.REPRO_DIR_ENV, str(tmp_path))
+    plan = schedules.SchedulePlan()
+    assert schedules.run_under_schedule(plan, lambda: 41 + 1) == 42
+    assert os.listdir(tmp_path) == []
+    assert schedules.active_schedule() is None
+
+
+# ------------------------------------------------------------- interposition
+
+def test_cross_is_inert_without_a_plan():
+    assert schedules.active_schedule() is None
+    schedules.cross("anything.at.all")  # must not raise, must not record
+
+
+def test_instrument_swaps_primitives_and_is_idempotent():
+    class Thing:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._rlock = threading.RLock()
+            self._ev = threading.Event()
+            self.data = []  # untouched
+
+    t = schedules.instrument(Thing())
+    assert isinstance(t._lock, schedules.TracedLock)
+    assert isinstance(t._rlock, schedules.TracedLock)
+    assert isinstance(t._ev, schedules.TracedEvent)
+    assert t._lock.site == "Thing._lock"
+    first = t._lock
+    assert schedules.instrument(t)._lock is first  # no double-wrap
+
+    # wrappers behave like the originals
+    with t._lock:
+        assert t._lock.locked()
+    assert not t._lock.locked()
+    t._ev.set()
+    assert t._ev.is_set() and t._ev.wait(0)
+    t._ev.clear()
+    assert not t._ev.is_set()
+
+
+def test_traced_lock_announces_boundaries():
+    plan = schedules.install_schedule(schedules.SchedulePlan())
+    try:
+        lock = schedules.TracedLock(threading.Lock(), "L")
+        with lock:
+            pass
+        assert plan.crossings() == {
+            "L.acquire": 1, "L.held": 1, "L.release": 1}
+    finally:
+        schedules.clear_schedule()
+
+
+# ------------------------------------------------------------- the toy pair
+
+def test_toy_race_caught_under_forced_schedule():
+    # THE acceptance assertion: the seeded race manifests on the first
+    # run, every run, under the forced preemption at the read-region
+    # exit — and the plan actually injected the stall (fired > 0), so
+    # this can never silently decay into a vacuous pass
+    toy = _load_toy()
+    plan = schedules.SchedulePlan(seed=1).preempt_at(
+        TOY_SITE, at=1, delay=0.05)
+    assert toy.lost_update_under(plan) is True
+    assert plan.fired >= 1
+
+
+def test_toy_race_missed_without_interposition():
+    # the second half of the guard: WITHOUT the harness the window (a
+    # few bytecodes) never loses across 20 straight runs.  A long
+    # switch interval makes "never" deterministic rather than merely
+    # overwhelmingly likely — if this ever fails, the toy no longer
+    # needs the harness and both fixtures must be rethought.
+    toy = _load_toy()
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(0.5)
+    try:
+        for _ in range(20):
+            assert toy.hammer(toy.RacyToyScheduler()) == 2
+    finally:
+        sys.setswitchinterval(old)
+
+
+def test_toy_fix_survives_the_same_hostile_schedule():
+    # the fix (one lock region spanning read+write) under the IDENTICAL
+    # schedule: the stall still fires, the update is never lost
+    toy = _load_toy()
+    plan = schedules.SchedulePlan(seed=1).preempt_at(
+        "FixedToyScheduler._lock.release", at=1, delay=0.05)
+    assert toy.lost_update_under(plan, cls=toy.FixedToyScheduler) is False
+    assert plan.fired >= 1
+
+
+def test_toy_fix_survives_seeded_fuzz():
+    toy = _load_toy()
+    for seed in (0, 1, 2):
+        plan = schedules.SchedulePlan(seed=seed).fuzz(prob=0.5, delay=0.01)
+        assert toy.lost_update_under(plan, cls=toy.FixedToyScheduler) is False
+
+
+# ------------------------------------------------------------- real objects
+
+def _drive_until(sched, pred, max_steps=100_000):
+    for _ in range(max_steps):
+        if pred():
+            return
+        sched.step()
+    raise AssertionError("driver did not reach condition")
+
+
+def test_scheduler_tokens_correct_under_fuzzed_schedule():
+    # concurrent submitters + the driver thread stepping, every lock
+    # boundary fuzz-stalled: each request's output must still match the
+    # fake_tokens oracle and nothing may be lost or double-finished
+    eng = FakeLMEngine(max_slots=2)
+    sched = schedules.instrument(Scheduler(eng, max_queue=64))
+    reqs = [Request(prompt=[i, i + 1], max_new_tokens=4) for i in range(8)]
+    plan = schedules.SchedulePlan(seed=5).fuzz(prob=0.3, delay=0.002)
+
+    def run():
+        barrier = threading.Barrier(2)
+
+        def submitter(chunk):
+            barrier.wait()
+            for r in chunk:
+                sched.submit(r)
+
+        threads = [threading.Thread(target=submitter, args=(reqs[i::2],))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        _drive_until(sched, lambda: all(r.done.is_set() for r in reqs))
+        for t in threads:
+            t.join()
+
+    schedules.run_under_schedule(plan, run)
+    assert plan.fired > 0  # the schedule actually perturbed the run
+    for r in reqs:
+        assert r.generated == fake_tokens(r.prompt, r.max_new_tokens)
+    m = sched.metrics()
+    assert m["requests_submitted"] == 8
+    assert m["requests_finished"] == 8
+
+
+def test_scheduler_drain_is_all_or_nothing_under_preemption():
+    # the begin_drain fix under fire: stall inside the drain-latch lock
+    # region while submitters hammer — every submit must either raise
+    # Draining or run to completion with correct tokens; no request may
+    # be accepted and then dropped
+    eng = FakeLMEngine(max_slots=2)
+    sched = schedules.instrument(Scheduler(eng, max_queue=64))
+    plan = schedules.SchedulePlan(seed=9)
+    plan.preempt_at("Scheduler._lock.held", at=3, times=4, delay=0.01)
+    accepted, refused = [], []
+    acc_lock = threading.Lock()
+
+    def run():
+        barrier = threading.Barrier(3)
+
+        def submitter(base):
+            barrier.wait()
+            for i in range(6):
+                r = Request(prompt=[base, i], max_new_tokens=3)
+                try:
+                    sched.submit(r)
+                except Draining:
+                    with acc_lock:
+                        refused.append(r)
+                else:
+                    with acc_lock:
+                        accepted.append(r)
+
+        threads = [threading.Thread(target=submitter, args=(b,))
+                   for b in (10, 20)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        sched.begin_drain()
+        for t in threads:
+            t.join()
+        sched.run_until_idle()
+
+    schedules.run_under_schedule(plan, run)
+    assert len(accepted) + len(refused) == 12
+    for r in accepted:  # accepted => completed, correctly
+        assert r.done.is_set()
+        assert r.generated == fake_tokens(r.prompt, r.max_new_tokens)
+    for r in refused:  # refused => never entered the machine
+        assert not r.done.is_set() and r.generated == []
+    with pytest.raises(Draining):
+        sched.submit(Request(prompt=[1], max_new_tokens=1))
+
+
+def test_faultplan_concurrent_arming_under_preemption():
+    # the FaultPlan fix under fire: threads arming faults while another
+    # fires — stalls injected inside the plan's own lock regions must
+    # not lose an armed fault or corrupt the traversal
+    plan = schedules.SchedulePlan(seed=4).fuzz(prob=0.4, delay=0.002)
+    fp = schedules.instrument(faults.FaultPlan())
+    fired = []
+
+    def run():
+        barrier = threading.Barrier(3)
+
+        def armer(k):
+            barrier.wait()
+            for i in range(5):
+                fp.fail(f"site-{k}-{i}", message="x")
+
+        def firer():
+            barrier.wait()
+            for _ in range(40):
+                try:
+                    fp.fire("site-0-0")
+                except faults.FaultInjected:
+                    fired.append(1)
+
+        threads = [threading.Thread(target=armer, args=(k,))
+                   for k in (0, 1)] + [threading.Thread(target=firer)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    schedules.run_under_schedule(plan, run)
+    # every armed fault is present: each never-fired single-shot site
+    # still raises exactly once — a lost append would pass silently here
+    for k in (0, 1):
+        for i in range(5):
+            if (k, i) == (0, 0) and fired:
+                continue
+            with pytest.raises(faults.FaultInjected):
+                fp.fire(f"site-{k}-{i}")
+
+
+def test_faultplan_static_pin():
+    # the static half of the regression pair: faults.py scans FDT3xx
+    # clean (the unlocked appends this layer originally caught stay
+    # fixed)
+    findings = concurrency.run_concurrency_checks(
+        ["fluxdistributed_tpu/faults.py"])
+    assert findings == [], findings
+
+
+def test_watchdog_concurrent_beats_under_fuzz():
+    reg = Registry()  # private: no cross-test gauge collisions
+    wd = schedules.instrument(StepWatchdog(registry=reg))
+    plan = schedules.SchedulePlan(seed=6).fuzz(prob=0.3, delay=0.001)
+
+    def run():
+        barrier = threading.Barrier(2)
+
+        def beater():
+            barrier.wait()
+            for _ in range(50):
+                wd.beat()
+
+        threads = [threading.Thread(target=beater) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wd.poll()
+
+    schedules.run_under_schedule(plan, run)
+    assert plan.fired > 0
+    # 100 beats from 2 threads, none lost to a stalled interleaving,
+    # and no spurious stall episode from the injected delays
+    assert wd._beats == 100
+    assert reg.value("fdtpu_watchdog_stalls_total") == 0.0
+
+
+def test_flight_recorder_loses_nothing_under_fuzz(tmp_path):
+    path = str(tmp_path / "flight.jsonl")
+    fr = schedules.instrument(FlightRecorder(path, ring=512, flush_every=4))
+    plan = schedules.SchedulePlan(seed=8).fuzz(prob=0.3, delay=0.001)
+
+    def run():
+        barrier = threading.Barrier(3)
+
+        def writer(k):
+            barrier.wait()
+            for i in range(40):
+                fr.record(src=k, i=i)
+
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    schedules.run_under_schedule(plan, run)
+    assert plan.fired > 0
+    assert fr.recorded == 120
+    fr.dump("ok")
+    on_disk = [json.loads(l) for l in open(path) if l.strip()]
+    recs = [r for r in on_disk if r.get("kind") == "record"]
+    assert len(recs) == 120  # crash-durable: every record flushed
+    # per-writer streams arrive intact and in program order
+    for k in range(3):
+        assert [r["i"] for r in recs if r["src"] == k] == list(range(40))
